@@ -1,0 +1,13 @@
+"""lighthouse_tpu — a TPU-native framework with the capabilities of the
+Lighthouse Ethereum consensus client, built for JAX/XLA/Pallas/pjit.
+
+Package map (SURVEY.md §7.1):
+  crypto/    BLS12-381 + hashing: pure-Python oracle + backend seam
+  ops/       batched device kernels (limb field arithmetic, curves, pairing)
+  models/    the flagship batched signature-set verifier (jittable)
+  parallel/  device mesh + shard_map sharding of verification batches
+  consensus/ SSZ, tree hashing, spec types, state transition, fork choice
+  utils/     limb packing, misc support
+"""
+
+__version__ = "0.1.0"

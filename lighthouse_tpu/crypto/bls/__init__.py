@@ -1,0 +1,42 @@
+"""BLS12-381 for the TPU-native lighthouse rebuild.
+
+Public surface mirrors the reference's crypto/bls crate (lib.rs:95-151).
+"""
+
+from .api import (
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_pubkeys,
+    verify_signature_sets,
+)
+from .backends import get_backend, register_backend, set_default_backend
+from .constants import (
+    INFINITY_PUBLIC_KEY,
+    INFINITY_SIGNATURE,
+    PUBLIC_KEY_BYTES_LEN,
+    SECRET_KEY_BYTES_LEN,
+    SIGNATURE_BYTES_LEN,
+)
+
+__all__ = [
+    "AggregateSignature",
+    "BlsError",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "SignatureSet",
+    "aggregate_pubkeys",
+    "verify_signature_sets",
+    "get_backend",
+    "register_backend",
+    "set_default_backend",
+    "INFINITY_PUBLIC_KEY",
+    "INFINITY_SIGNATURE",
+    "PUBLIC_KEY_BYTES_LEN",
+    "SECRET_KEY_BYTES_LEN",
+    "SIGNATURE_BYTES_LEN",
+]

@@ -1,0 +1,67 @@
+"""BLS backend registry — runtime equivalent of the reference's compile-time
+backend features (crypto/bls/Cargo.toml:23-29: supranational | milagro |
+fake_crypto). Backends:
+
+  * ``python`` — the pure big-int oracle (this package).
+  * ``fake``   — always-valid stub, used to run state-transition tests without
+                 crypto cost (reference: impls/fake_crypto.rs).
+  * ``jax``    — batched TPU path (lighthouse_tpu/models/verifier.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+
+class Backend(Protocol):
+    def verify_signature_sets(self, sets) -> bool: ...
+
+
+class PythonBackend:
+    name = "python"
+
+    def verify_signature_sets(self, sets) -> bool:
+        from .api import verify_signature_sets_python
+
+        return verify_signature_sets_python(sets)
+
+
+class FakeBackend:
+    """Always-valid: mirrors impls/fake_crypto.rs:29-33 (returns true), while
+    still rejecting structurally-invalid inputs (empty set list)."""
+
+    name = "fake"
+
+    def verify_signature_sets(self, sets) -> bool:
+        return len(sets) > 0
+
+
+_REGISTRY: dict[str, Backend] = {}
+_default: str | None = None
+
+
+def register_backend(name: str, backend: Backend) -> None:
+    _REGISTRY[name] = backend
+
+
+def set_default_backend(name: str) -> None:
+    global _default
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown BLS backend {name!r}; known: {sorted(_REGISTRY)}")
+    _default = name
+
+
+def get_backend(name: str | None = None) -> Backend:
+    if name is None:
+        name = _default or os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "python")
+    if name == "jax" and name not in _REGISTRY:
+        # Lazy import so pure-host users never pay the JAX import cost.
+        from ..jax_backend import JaxBackend  # noqa: F401  (registers itself)
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown BLS backend {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+register_backend("python", PythonBackend())
+register_backend("fake", FakeBackend())

@@ -1,0 +1,40 @@
+"""eth2 hashing — SHA-256 wrapper + zero-hash cache.
+
+Capability parity with the reference's crypto/eth2_hashing (src/lib.rs:20-37):
+``hash``, ``hash_fixed``, ``hash32_concat``, and the lazily-built
+``ZERO_HASHES`` table used by merkleization. Host-side hashlib is already
+hardware-accelerated; a batched tree-hash kernel is a later TPU offload
+candidate (SURVEY §2.6 item 2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+ZERO_HASHES_MAX_INDEX = 48
+
+
+def hash_bytes(data: bytes) -> bytes:
+    """SHA-256 of arbitrary bytes (reference: eth2_hashing::hash)."""
+    return hashlib.sha256(data).digest()
+
+
+def hash_fixed(data: bytes) -> bytes:
+    """Alias kept for parity with eth2_hashing::hash_fixed."""
+    return hashlib.sha256(data).digest()
+
+
+def hash32_concat(a: bytes, b: bytes) -> bytes:
+    """SHA-256(a || b) for two 32-byte inputs (merkle node combine)."""
+    return hashlib.sha256(a + b).digest()
+
+
+def _build_zero_hashes() -> list[bytes]:
+    table = [bytes(32)]
+    for _ in range(ZERO_HASHES_MAX_INDEX):
+        table.append(hash32_concat(table[-1], table[-1]))
+    return table
+
+
+# zero_hashes[i] = root of an all-zero merkle tree of depth i
+ZERO_HASHES: list[bytes] = _build_zero_hashes()

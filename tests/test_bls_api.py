@@ -1,0 +1,125 @@
+"""BLS public API tests — the reference's crypto/bls behavioral contract."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import (
+    AggregateSignature,
+    BlsError,
+    INFINITY_PUBLIC_KEY,
+    INFINITY_SIGNATURE,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_pubkeys,
+    get_backend,
+    verify_signature_sets,
+)
+
+MSG = bytes(range(32))
+MSG2 = b"\x42" * 32
+
+
+@pytest.fixture(scope="module")
+def keypairs():
+    sks = [SecretKey.from_int(i + 1000) for i in range(4)]
+    return sks, [sk.public_key() for sk in sks]
+
+
+@pytest.fixture(scope="module")
+def signatures(keypairs):
+    sks, _ = keypairs
+    return [sk.sign(MSG) for sk in sks]
+
+
+def test_sign_verify(keypairs, signatures):
+    _, pks = keypairs
+    assert signatures[0].verify(pks[0], MSG)
+    assert not signatures[0].verify(pks[1], MSG)
+    assert not signatures[0].verify(pks[0], MSG2)
+
+
+def test_serialization_roundtrips(keypairs, signatures):
+    sks, pks = keypairs
+    for pk in pks:
+        assert PublicKey.from_bytes(pk.to_bytes()) == pk
+        assert len(pk.to_bytes()) == 48
+    for sig in signatures:
+        assert Signature.from_bytes(sig.to_bytes()) == sig
+        assert len(sig.to_bytes()) == 96
+    for sk in sks:
+        assert SecretKey.from_bytes(sk.to_bytes()).sk == sk.sk
+
+
+def test_infinity_pubkey_rejected():
+    with pytest.raises(BlsError):
+        PublicKey.from_bytes(INFINITY_PUBLIC_KEY)
+
+
+def test_infinity_signature_deserializes():
+    sig = Signature.from_bytes(INFINITY_SIGNATURE)
+    assert sig.is_infinity()
+
+
+def test_fast_aggregate_verify(keypairs, signatures):
+    _, pks = keypairs
+    agg = AggregateSignature.aggregate(signatures)
+    assert agg.fast_aggregate_verify(pks, MSG)
+    assert not agg.fast_aggregate_verify(pks[:3], MSG)
+    assert not agg.fast_aggregate_verify(pks, MSG2)
+    assert not agg.fast_aggregate_verify([], MSG)
+
+
+def test_eth_fast_aggregate_verify_infinity_special_case():
+    assert AggregateSignature.infinity().eth_fast_aggregate_verify([], MSG)
+    assert not AggregateSignature.infinity().fast_aggregate_verify([], MSG)
+
+
+def test_aggregate_empty_errors():
+    with pytest.raises(BlsError):
+        AggregateSignature.aggregate([])
+    with pytest.raises(BlsError):
+        aggregate_pubkeys([])
+
+
+def test_aggregate_verify(keypairs):
+    sks, pks = keypairs
+    msgs = [bytes([i]) * 32 for i in range(len(sks))]
+    agg = AggregateSignature.aggregate([sk.sign(m) for sk, m in zip(sks, msgs)])
+    assert agg.aggregate_verify(pks, msgs)
+    assert not agg.aggregate_verify(pks, list(reversed(msgs)))
+    assert not agg.aggregate_verify(pks[:-1], msgs[:-1])
+
+
+def test_verify_signature_sets(keypairs, signatures):
+    _, pks = keypairs
+    sets = [SignatureSet.single_pubkey(s, pk, MSG) for s, pk in zip(signatures, pks)]
+    agg = AggregateSignature.aggregate(signatures)
+    sets.append(SignatureSet.multiple_pubkeys(agg, pks, MSG))
+    assert verify_signature_sets(sets)
+    # one bad set poisons the batch
+    bad = sets + [SignatureSet.single_pubkey(signatures[0], pks[1], MSG)]
+    assert not verify_signature_sets(bad)
+
+
+def test_verify_signature_sets_edge_cases(keypairs, signatures):
+    _, pks = keypairs
+    assert not verify_signature_sets([])
+    inf = AggregateSignature.infinity()
+    assert not verify_signature_sets([SignatureSet(inf, [pks[0]], MSG)])
+    some = AggregateSignature(signatures[0].point)
+    assert not verify_signature_sets([SignatureSet(some, [], MSG)])
+
+
+def test_fake_backend(keypairs, signatures):
+    _, pks = keypairs
+    fake = get_backend("fake")
+    bad = [SignatureSet.single_pubkey(signatures[0], pks[1], MSG)]
+    assert fake.verify_signature_sets(bad)  # fake_crypto: always true
+    assert not fake.verify_signature_sets([])
+
+
+def test_signature_set_verify_single(keypairs, signatures):
+    _, pks = keypairs
+    assert SignatureSet.single_pubkey(signatures[1], pks[1], MSG).verify()
+    assert not SignatureSet.single_pubkey(signatures[1], pks[0], MSG).verify()

@@ -1,0 +1,91 @@
+"""G1/G2 group law, serialization, and subgroup checks."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import constants as C
+from lighthouse_tpu.crypto.bls.curve import (
+    DeserializeError,
+    g1_from_compressed,
+    g1_generator,
+    g1_infinity,
+    g1_subgroup_check,
+    g1_to_compressed,
+    g2_from_compressed,
+    g2_generator,
+    g2_infinity,
+    g2_subgroup_check,
+    g2_to_compressed,
+    psi,
+)
+
+
+def test_generators_on_curve():
+    assert g1_generator().is_on_curve()
+    assert g2_generator().is_on_curve()
+
+
+def test_generator_serialization_anchors():
+    # Known-good compressed encodings from the BLS12-381 specification.
+    assert g1_to_compressed(g1_generator()) == C.G1_COMPRESSED
+    assert g2_to_compressed(g2_generator()) == C.G2_COMPRESSED
+    assert g1_from_compressed(C.G1_COMPRESSED) == g1_generator()
+    assert g2_from_compressed(C.G2_COMPRESSED) == g2_generator()
+
+
+def test_group_law():
+    g = g1_generator()
+    assert g.add(g) == g.double()
+    assert g.mul(2) == g.double()
+    assert g.mul(3) == g.double().add(g)
+    assert g.add(g.neg()).infinity
+    assert g.mul(0).infinity
+    # scalar mul distributes
+    assert g.mul(7).add(g.mul(5)) == g.mul(12)
+    h = g2_generator()
+    assert h.mul(7).add(h.mul(5)) == h.mul(12)
+
+
+def test_subgroup_checks():
+    assert g1_subgroup_check(g1_generator().mul(123456789))
+    assert g2_subgroup_check(g2_generator().mul(987654321))
+    assert g1_generator().mul(C.R).infinity
+    assert g2_generator().mul(C.R).infinity
+
+
+def test_psi_endomorphism_preserves_curve():
+    p = g2_generator().mul(42)
+    q = psi(p)
+    assert q.is_on_curve()
+    assert g2_subgroup_check(q)
+
+
+def test_compressed_roundtrip_random_points():
+    for k in (1, 2, 31415, C.R - 1):
+        p1 = g1_generator().mul(k)
+        assert g1_from_compressed(g1_to_compressed(p1)) == p1
+        p2 = g2_generator().mul(k)
+        assert g2_from_compressed(g2_to_compressed(p2)) == p2
+
+
+def test_infinity_encoding():
+    assert g1_to_compressed(g1_infinity()) == C.INFINITY_PUBLIC_KEY
+    assert g2_to_compressed(g2_infinity()) == C.INFINITY_SIGNATURE
+    assert g1_from_compressed(C.INFINITY_PUBLIC_KEY).infinity
+    assert g2_from_compressed(C.INFINITY_SIGNATURE).infinity
+
+
+def test_deserialize_errors():
+    with pytest.raises(DeserializeError):
+        g1_from_compressed(bytes(48))  # compression bit missing
+    with pytest.raises(DeserializeError):
+        g1_from_compressed(bytes([0x80]) + bytes(46))  # wrong length
+    with pytest.raises(DeserializeError):
+        # x >= p
+        g1_from_compressed(bytes([0x9F]) + b"\xff" * 47)
+    with pytest.raises(DeserializeError):
+        g1_from_compressed(C.INFINITY_PUBLIC_KEY, allow_infinity=False)
+    # malformed infinity (extra bits set)
+    bad = bytearray(C.INFINITY_PUBLIC_KEY)
+    bad[5] = 1
+    with pytest.raises(DeserializeError):
+        g1_from_compressed(bytes(bad))

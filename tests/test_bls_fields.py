@@ -1,0 +1,85 @@
+"""Field-tower algebra tests for the pure-Python BLS12-381 oracle."""
+
+import secrets
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.constants import P, R, X
+from lighthouse_tpu.crypto.bls.fields import Fq2, Fq6, Fq12
+
+
+def rand_fq2() -> Fq2:
+    return Fq2(secrets.randbelow(P), secrets.randbelow(P))
+
+
+def rand_fq6() -> Fq6:
+    return Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12() -> Fq12:
+    return Fq12(rand_fq6(), rand_fq6())
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_fq2_ring_axioms(trial):
+    a, b, c = rand_fq2(), rand_fq2(), rand_fq2()
+    assert (a + b) * c == a * c + b * c
+    assert a * b == b * a
+    assert a.square() == a * a
+    assert (a * b) * c == a * (b * c)
+
+
+def test_fq2_inverse():
+    for _ in range(4):
+        a = rand_fq2()
+        assert a * a.inv() == Fq2.one()
+
+
+def test_fq2_sqrt_roundtrip():
+    for _ in range(4):
+        a = rand_fq2()
+        sq = a.square()
+        r = sq.sqrt()
+        assert r is not None
+        assert r.square() == sq
+
+
+def test_fq6_mul_by_v_consistent():
+    v = Fq6(Fq2.zero(), Fq2.one(), Fq2.zero())
+    a = rand_fq6()
+    assert a.mul_by_v() == a * v
+
+
+def test_fq6_inverse():
+    a = rand_fq6()
+    assert a * a.inv() == Fq6.one()
+
+
+def test_fq12_inverse_and_conj():
+    a = rand_fq12()
+    assert a * a.inv() == Fq12.one()
+    # conj = frobenius^6 (raising to p^6)
+    assert a.conj() == a.frobenius_n(6)
+
+
+def test_frobenius_is_pth_power():
+    a = rand_fq2()
+    assert a.frobenius() == a.pow(P)
+    b = rand_fq12()
+    assert b.frobenius() == b.pow(P)
+
+
+def test_fq12_tower_relation():
+    # w^2 == v in the tower
+    w = Fq12(Fq6.zero(), Fq6.one())
+    v = Fq12(Fq6(Fq2.zero(), Fq2.one(), Fq2.zero()), Fq6.zero())
+    assert w.square() == v
+
+
+def test_hard_part_exponent_identity():
+    # 3*(p^4 - p^2 + 1)/r == (x-1)^2 (x+p)(x^2+p^2-1) + 3 — the HHT chain
+    # used in final_exponentiation computes exactly three times the hard part.
+    lhs = (P**4 - P**2 + 1) // R
+    rhs = (X - 1) ** 2 * (X + P) * (X**2 + P**2 - 1) + 3
+    assert (P**4 - P**2 + 1) % R == 0
+    assert rhs == 3 * lhs

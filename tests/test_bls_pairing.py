@@ -1,0 +1,62 @@
+"""Pairing correctness: bilinearity, non-degeneracy, final-exp chain."""
+
+import secrets
+
+from lighthouse_tpu.crypto.bls.constants import P, R
+from lighthouse_tpu.crypto.bls.curve import g1_generator, g2_generator
+from lighthouse_tpu.crypto.bls.fields import Fq12
+from lighthouse_tpu.crypto.bls.pairing import (
+    final_exponentiation,
+    miller_loop,
+    multi_pairing,
+    pairing,
+)
+from tests.test_bls_fields import rand_fq12
+
+
+def test_non_degenerate_and_order_r():
+    e = pairing(g1_generator(), g2_generator())
+    assert not e.is_one()
+    assert e.pow(R).is_one()
+
+
+def test_bilinearity():
+    g1, g2 = g1_generator(), g2_generator()
+    a = secrets.randbelow(2**64) + 1
+    b = secrets.randbelow(2**64) + 1
+    e = pairing(g1, g2)
+    assert pairing(g1.mul(a), g2) == e.pow(a)
+    assert pairing(g1, g2.mul(b)) == e.pow(b)
+    assert pairing(g1.mul(a), g2.mul(b)) == e.pow((a * b) % R)
+
+
+def test_pairing_with_infinity_is_one():
+    g1, g2 = g1_generator(), g2_generator()
+    assert pairing(g1.mul(0), g2).is_one()
+    assert pairing(g1, g2.mul(0)).is_one()
+
+
+def test_multi_pairing_cancellation():
+    # e(aG1, G2) * e(-aG1, G2) == 1
+    g1, g2 = g1_generator(), g2_generator()
+    a = 987654321
+    assert multi_pairing([(g1.mul(a), g2), (g1.mul(a).neg(), g2)]).is_one()
+
+
+def test_final_exponentiation_matches_integer_exponent():
+    # The optimized chain computes f^(3*(p^12-1)/r) for arbitrary nonzero f.
+    f = rand_fq12()
+    expected = f.pow(3 * ((P**12 - 1) // R))
+    assert final_exponentiation(f) == expected
+
+
+def test_signature_equation():
+    # e(pk, H) == e(G1, sk*H) for sk*G1 = pk — the BLS verification identity.
+    g1, g2 = g1_generator(), g2_generator()
+    sk = 0xDEADBEEFCAFE
+    h = g2.mul(31337)  # stand-in for a hashed message point
+    lhs = pairing(g1.mul(sk), h)
+    rhs = pairing(g1, h.mul(sk))
+    assert lhs == rhs
+    f = miller_loop(g1.mul(sk), h) * miller_loop(g1.neg(), h.mul(sk))
+    assert final_exponentiation(f).is_one()

@@ -1,0 +1,69 @@
+"""hash-to-G2 (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_) tests."""
+
+import secrets
+
+from lighthouse_tpu.crypto.bls import constants as C
+from lighthouse_tpu.crypto.bls.curve import clear_cofactor_g2, g2_subgroup_check
+from lighthouse_tpu.crypto.bls.fields import Fq2
+from lighthouse_tpu.crypto.bls.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_field_fq2,
+    hash_to_g2,
+    iso3_map,
+    map_to_curve_g2,
+    sswu_map_fq2,
+)
+
+# The h_eff from RFC 9380 §8.8.2; clear_cofactor_g2 uses the endomorphism
+# decomposition and must agree exactly.
+H_EFF = int(
+    "bc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff031508ffe1329c2f178731d"
+    "b956d82bf015d1212b02ec0ec69d7477c1ae954cbc06689f6a359894c0adebbf6b4e8020005aaa95551",
+    16,
+)
+
+
+def test_expand_message_xmd_lengths_and_determinism():
+    out1 = expand_message_xmd(b"msg", b"DST", 256)
+    out2 = expand_message_xmd(b"msg", b"DST", 256)
+    assert out1 == out2
+    assert len(out1) == 256
+    assert len(expand_message_xmd(b"", b"DST", 17)) == 17
+    assert expand_message_xmd(b"msg", b"DST2", 32) != expand_message_xmd(b"msg", b"DST", 32)
+
+
+def test_sswu_lands_on_isogenous_curve():
+    a = Fq2.from_tuple(C.SSWU_A2)
+    b = Fq2.from_tuple(C.SSWU_B2)
+    for _ in range(6):
+        u = Fq2(secrets.randbelow(C.P), secrets.randbelow(C.P))
+        x, y = sswu_map_fq2(u)
+        assert y.square() == (x.square() + a) * x + b
+
+
+def test_iso3_maps_onto_e2():
+    for _ in range(6):
+        u = Fq2(secrets.randbelow(C.P), secrets.randbelow(C.P))
+        pt = map_to_curve_g2(u)
+        assert pt.is_on_curve()
+
+
+def test_clear_cofactor_equals_h_eff_scalar_mul():
+    u = hash_to_field_fq2(b"cofactor-test", 2)[0]
+    q = map_to_curve_g2(u)
+    assert clear_cofactor_g2(q) == q.mul(H_EFF)
+
+
+def test_hash_to_g2_in_subgroup_and_deterministic():
+    p1 = hash_to_g2(b"hello")
+    p2 = hash_to_g2(b"hello")
+    assert p1 == p2
+    assert p1.is_on_curve() and not p1.infinity
+    assert g2_subgroup_check(p1)
+    assert hash_to_g2(b"world") != p1
+
+
+def test_hash_to_field_range():
+    for elem in hash_to_field_fq2(b"range", 2):
+        assert 0 <= elem.c0 < C.P
+        assert 0 <= elem.c1 < C.P
