@@ -5,7 +5,7 @@ fake_crypto). Backends:
   * ``python`` — the pure big-int oracle (this package).
   * ``fake``   — always-valid stub, used to run state-transition tests without
                  crypto cost (reference: impls/fake_crypto.rs).
-  * ``jax``    — batched TPU path (lighthouse_tpu/models/verifier.py).
+  * ``jax``    — batched TPU path (lighthouse_tpu/jax_backend.py).
 """
 
 from __future__ import annotations
@@ -57,7 +57,7 @@ def get_backend(name: str | None = None) -> Backend:
         name = _default or os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "python")
     if name == "jax" and name not in _REGISTRY:
         # Lazy import so pure-host users never pay the JAX import cost.
-        from ..jax_backend import JaxBackend  # noqa: F401  (registers itself)
+        from lighthouse_tpu.jax_backend import JaxBackend  # noqa: F401  (registers itself)
     if name not in _REGISTRY:
         raise KeyError(f"unknown BLS backend {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name]

@@ -1,0 +1,214 @@
+"""Batched BLS12-381 base-field (Fp) limb arithmetic for TPU.
+
+This is the foundation of the device compute path: everything the reference
+client gets from blst's C/assembly field arithmetic (reference:
+crypto/bls/src/impls/blst.rs, which wraps Supranational blst) is re-expressed
+here as batched integer-limb arithmetic that XLA can vectorize over a leading
+batch dimension and (later) Pallas can map onto the MXU.
+
+Representation
+--------------
+An Fp element is ``int32[..., 48]``: 48 little-endian limbs of 8 bits each
+(384 bits total, p is 381 bits). Rationale:
+
+* TPUs have no 64-bit (or even full 32-bit) widening multiply in the vector
+  unit. With 8-bit limbs, a schoolbook product term is < 2^16 and a full
+  48-term convolution column plus Montgomery accumulation stays < 2^24 —
+  comfortably inside int32 lanes with no carries needed mid-kernel.
+* The two inner products (the a*b convolution and the m*p fold) are exactly
+  the shape of an int8 x int8 -> int32 MXU matmul, which is the planned
+  Pallas optimization; this module is the semantics reference for it.
+
+Invariants: every value is in [0, 2p) (lazy "almost-reduced" form, standard
+for Montgomery pipelines); limbs are normalized to [0, 255] on function exit.
+Canonical reduction to [0, p) happens only at comparison/serialization
+boundaries (:func:`canonical`).
+
+All public functions are shape-polymorphic: they operate on the trailing limb
+axis and broadcast/vectorize over every leading axis, so a whole Fp12 tower
+operation (24 coefficients) or a 1M-element verification batch is one fused
+XLA op sequence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.constants import P
+
+# ----------------------------------------------------------------- parameters
+
+LIMB_BITS = 8
+LIMB_MASK = (1 << LIMB_BITS) - 1
+N_LIMBS = 48  # 48 * 8 = 384 bits >= 381
+R_BITS = N_LIMBS * LIMB_BITS  # Montgomery R = 2^384
+
+R_MONT = (1 << R_BITS) % P          # R mod p
+R2_MONT = (R_MONT * R_MONT) % P     # R^2 mod p  (to_mont multiplier)
+# -p^{-1} mod 2^8 — the per-digit Montgomery quotient constant.
+NINV8 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int -> int32[48] limb vector (little-endian)."""
+    if x < 0 or x >= (1 << R_BITS):
+        raise ValueError("value out of limb range")
+    return np.frombuffer(x.to_bytes(N_LIMBS, "little"), dtype=np.uint8).astype(
+        np.int32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side: limb vector (any nonneg int32 values) -> python int."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """Host-side batch conversion: iterable of ints -> int32[n, 48]."""
+    xs = list(xs)
+    buf = b"".join(int(x).to_bytes(N_LIMBS, "little") for x in xs)
+    return (
+        np.frombuffer(buf, dtype=np.uint8).astype(np.int32).reshape(len(xs), N_LIMBS)
+    )
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P))
+TWO_P_LIMBS = jnp.asarray(int_to_limbs(2 * P))
+R2_LIMBS = jnp.asarray(int_to_limbs(R2_MONT))
+ONE_LIMBS = jnp.asarray(int_to_limbs(1))
+R_LIMBS = jnp.asarray(int_to_limbs(R_MONT))  # 1 in Montgomery form
+ZERO_LIMBS = jnp.asarray(int_to_limbs(0))
+
+
+# ------------------------------------------------------------------- carries
+
+
+def _carry_scan(t):
+    """Full sequential carry/borrow propagation over the trailing limb axis.
+
+    Accepts signed int32 limbs (e.g. from a lazy subtraction); returns
+    ``(normalized_limbs, carry_out)`` where limbs are in [0, 255] and
+    ``carry_out`` is the signed overflow past the top limb (0 for in-range
+    values, -1 for a net-negative value). Arithmetic right shift implements
+    floor division so negative borrows propagate correctly.
+    """
+    x = jnp.moveaxis(t, -1, 0)
+
+    def step(c, xi):
+        s = xi + c
+        return s >> LIMB_BITS, s & LIMB_MASK
+
+    carry, out = jax.lax.scan(step, jnp.zeros(x.shape[1:], jnp.int32), x)
+    return jnp.moveaxis(out, 0, -1), carry
+
+
+# --------------------------------------------------------------- add/sub/neg
+
+
+def add(a, b):
+    """(a + b) mod-ish: result ≡ a+b (mod p), in [0, 2p), limbs normalized."""
+    s, _ = _carry_scan(a + b)                    # value < 4p < 2^384
+    d, borrow = _carry_scan(s - TWO_P_LIMBS)     # s - 2p
+    take_d = (borrow == 0)[..., None]            # s >= 2p
+    return jnp.where(take_d, d, s)
+
+
+def sub(a, b):
+    """(a - b) mod-ish: result ≡ a-b (mod p), in [0, 2p)."""
+    d2, borrow = _carry_scan(a - b)
+    d1, _ = _carry_scan(a - b + TWO_P_LIMBS)
+    take_d2 = (borrow == 0)[..., None]           # a >= b
+    return jnp.where(take_d2, d2, d1)
+
+
+def neg(a):
+    """(-a) mod-ish, closed on [0, 2p): 0 -> 0, else 2p - a."""
+    return sub(jnp.broadcast_to(ZERO_LIMBS, a.shape), a)
+
+
+def double(a):
+    return add(a, a)
+
+
+# ------------------------------------------------------------ multiplication
+
+
+def _conv_schoolbook(a, b):
+    """96-column schoolbook convolution of two 48-limb operands.
+
+    Inputs must have limbs <= 255 so each column sum is < 48*255^2 < 2^22.
+    Returns int32[..., 96] un-normalized product columns.
+    """
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    t = jnp.zeros((*shape, 2 * N_LIMBS), jnp.int32)
+    for i in range(N_LIMBS):
+        t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+    return t
+
+
+def mont_mul(a, b):
+    """Montgomery product a*b*R^{-1} mod p, batched.
+
+    CIOS-style: full schoolbook convolution, then 48 digit-folding steps
+    (m = t_i * (-p^{-1}) mod 2^8; t += m*p << 8i; push carry), then one carry
+    normalization. Closed on [0, 2p): for R = 2^384 and a,b < 2p the output
+    (a*b + m_total*p)/R < (4p^2 + R*p)/R < 2p.
+
+    This is the single hot primitive of the whole framework — the Pallas/MXU
+    kernel will replace exactly this function.
+    """
+    t = _conv_schoolbook(a, b)
+    for i in range(N_LIMBS):
+        m = (t[..., i] * NINV8) & LIMB_MASK
+        t = t.at[..., i : i + N_LIMBS].add(m[..., None] * P_LIMBS)
+        t = t.at[..., i + 1].add(t[..., i] >> LIMB_BITS)
+    hi = t[..., N_LIMBS:]
+    out, _ = _carry_scan(hi)
+    return out
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def to_mont(a):
+    """Standard -> Montgomery domain: a * R mod p."""
+    return mont_mul(a, R2_LIMBS)
+
+
+def from_mont(a):
+    """Montgomery -> standard domain: a * R^{-1} mod p (canonical, < p)."""
+    return canonical(mont_mul(a, ONE_LIMBS))
+
+
+# ------------------------------------------------------- canonical / compare
+
+
+def canonical(a):
+    """Fully reduce an almost-reduced value into [0, p)."""
+    d, borrow = _carry_scan(a - P_LIMBS)
+    take_d = (borrow == 0)[..., None]
+    return jnp.where(take_d, d, a)
+
+
+def eq(a, b):
+    """Value equality mod p for almost-reduced inputs -> bool[...]."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def sgn0(a):
+    """RFC 9380 sgn0 (parity of the canonical representative) -> int32[...]."""
+    return canonical(a)[..., 0] & 1
+
+
+def cond_select(mask, a, b):
+    """Elementwise select: a where mask (bool[...]) else b, broadcasting over
+    the limb axis."""
+    return jnp.where(mask[..., None], a, b)

@@ -67,3 +67,48 @@ def test_hash_to_field_range():
     for elem in hash_to_field_fq2(b"range", 2):
         assert 0 <= elem.c0 < C.P
         assert 0 <= elem.c1 < C.P
+
+
+# --------------------------------------------------------------------------
+# RFC 9380 known-answer anchors (interop bit-exactness guard).
+
+RFC_EXPANDER_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+RFC_H2C_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# RFC 9380 Appendix K.1 (expand_message_xmd, SHA-256).
+def test_expand_message_xmd_rfc_k1():
+    got = expand_message_xmd(b"", RFC_EXPANDER_DST, 0x20)
+    assert got.hex() == (
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+
+
+# RFC 9380 Appendix J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_): full
+# hash_to_curve outputs P = (x, y) with Fp2 coords (c0, c1).
+RFC_J10_1 = {
+    b"": (
+        (0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+         0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D),
+        (0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+         0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6),
+    ),
+    b"abc": (
+        (0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+         0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8),
+        (0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+         0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16),
+    ),
+    b"abcdef0123456789": (
+        (0x121982811D2491FDE9BA7ED31EF9CA474F0E1501297F68C298E9F4C0028ADD35AEA8BB83D53C08CFC007C1E005723CD0,
+         0x190D119345B94FBD15497BCBA94ECF7DB2CBFD1E1FE7DA034D26CBBA169FB3968288B3FAFB265F9EBD380512A71C3F2C),
+        (0x05571A0F8D3C08D094576981F4A3B8EDA0A8E771FCDCC8ECCEAF1356A6ACF17574518ACB506E435B639353C2E14827C8,
+         0x0BB5E7572275C567462D91807DE765611490205A941A5A6AF3B1691BFE596C31225D3AABDF15FAFF860CB4EF17C7C3BE),
+    ),
+}
+
+
+def test_hash_to_g2_rfc_j10_1():
+    for msg, ((x0, x1), (y0, y1)) in RFC_J10_1.items():
+        pt = hash_to_g2(msg, RFC_H2C_DST)
+        assert (pt.x.c0, pt.x.c1) == (x0, x1), msg
+        assert (pt.y.c0, pt.y.c1) == (y0, y1), msg

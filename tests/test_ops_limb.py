@@ -1,0 +1,113 @@
+"""Property tests: batched limb arithmetic (ops/limb.py) vs the big-int oracle.
+
+Mirrors the reference's approach of cross-checking BLS backends against each
+other (reference: Makefile runs ef_tests under blst AND milagro); here the
+pure-Python oracle plays the trusted role.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.ops import limb
+
+rng = random.Random(0xB15)
+
+
+def rand_fp(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def rand_almost(n):
+    """Values in [0, 2p) — the almost-reduced domain the kernels live in."""
+    return [rng.randrange(2 * P) for _ in range(n)]
+
+
+def to_dev(xs):
+    return np.asarray(limb.ints_to_limbs(xs))
+
+
+def to_ints(arr):
+    return [limb.limbs_to_int(row) for row in np.asarray(arr)]
+
+
+def test_limb_roundtrip():
+    xs = rand_almost(16) + [0, 1, P - 1, P, 2 * P - 1]
+    assert to_ints(to_dev(xs)) == xs
+
+
+def test_add_matches_oracle():
+    a, b = rand_almost(64), rand_almost(64)
+    out = to_ints(limb.add(to_dev(a), to_dev(b)))
+    for x, y, z in zip(a, b, out):
+        assert z % P == (x + y) % P
+        assert 0 <= z < 2 * P
+
+
+def test_sub_matches_oracle():
+    a, b = rand_almost(64), rand_almost(64)
+    out = to_ints(limb.sub(to_dev(a), to_dev(b)))
+    for x, y, z in zip(a, b, out):
+        assert z % P == (x - y) % P
+        assert 0 <= z < 2 * P
+
+
+def test_neg_matches_oracle():
+    a = rand_almost(32) + [0]
+    out = to_ints(limb.neg(to_dev(a)))
+    for x, z in zip(a, out):
+        assert z % P == (-x) % P
+        assert 0 <= z <= 2 * P
+
+
+def test_mont_mul_matches_oracle():
+    a, b = rand_almost(64), rand_almost(64)
+    rinv = pow(1 << limb.R_BITS, -1, P)
+    out = to_ints(limb.mont_mul(to_dev(a), to_dev(b)))
+    for x, y, z in zip(a, b, out):
+        assert z % P == (x * y * rinv) % P
+        assert 0 <= z < 2 * P
+
+
+def test_mont_roundtrip_and_mul():
+    a, b = rand_fp(32), rand_fp(32)
+    am = limb.to_mont(to_dev(a))
+    bm = limb.to_mont(to_dev(b))
+    # from_mont(to_mont(x)) == x
+    assert to_ints(limb.from_mont(am)) == a
+    # mont_mul in the Montgomery domain is plain modular multiplication
+    prod = to_ints(limb.from_mont(limb.mont_mul(am, bm)))
+    for x, y, z in zip(a, b, prod):
+        assert z == (x * y) % P
+
+
+def test_canonical_eq_is_zero():
+    a = rand_fp(16)
+    av = to_dev(a)
+    a_shift = to_dev([x + P for x in a])  # same values mod p, almost-reduced
+    assert bool(np.all(np.asarray(limb.eq(av, a_shift))))
+    assert to_ints(limb.canonical(a_shift)) == a
+    zeros = to_dev([0, P])
+    assert bool(np.all(np.asarray(limb.is_zero(zeros))))
+    assert not bool(np.any(np.asarray(limb.is_zero(to_dev([1, P - 1])))))
+
+
+def test_sgn0():
+    a = rand_fp(16) + [0, 1, P - 1]
+    out = np.asarray(limb.sgn0(to_dev([x + P for x in a])))  # shifted reps
+    for x, s in zip(a, out):
+        assert int(s) == x % 2
+
+
+def test_broadcast_shapes():
+    """Ops must vectorize over arbitrary leading axes (tower stacking)."""
+    a = rand_fp(24)
+    b = rand_fp(24)
+    a3 = to_dev(a).reshape(2, 3, 4, limb.N_LIMBS)
+    b3 = to_dev(b).reshape(2, 3, 4, limb.N_LIMBS)
+    out = limb.mont_mul(limb.to_mont(a3), limb.to_mont(b3))
+    flat = to_ints(limb.from_mont(out).reshape(24, limb.N_LIMBS))
+    for x, y, z in zip(a, b, flat):
+        assert z == (x * y) % P
