@@ -135,18 +135,34 @@ def double(a):
 
 # ------------------------------------------------------------ multiplication
 
+# One-hot convolution tensor M[i*48+j, k] = 1 iff i+j == k, shaped so the
+# 96-column schoolbook product is a single (..., 2304) @ (2304, 96) matmul.
+# Products are < 2^16 and column sums < 48 * 255^2 < 2^22 < 2^24, so the
+# entire contraction is exact in float32 — which is precisely what lets the
+# MXU (a float/int8 systolic array with no 32-bit widening multiply) carry
+# the full 384-bit schoolbook product.
+_CONV_MAT = np.zeros((N_LIMBS * N_LIMBS, 2 * N_LIMBS), np.float32)
+for _i in range(N_LIMBS):
+    for _j in range(N_LIMBS):
+        _CONV_MAT[_i * N_LIMBS + _j, _i + _j] = 1.0
+CONV_MAT = jnp.asarray(_CONV_MAT)
+
 
 def _conv_schoolbook(a, b):
     """96-column schoolbook convolution of two 48-limb operands.
 
     Inputs must have limbs <= 255 so each column sum is < 48*255^2 < 2^22.
-    Returns int32[..., 96] un-normalized product columns.
+    Returns int32[..., 96] un-normalized product columns. Implemented as an
+    outer product + one-hot matmul so XLA maps it onto the MXU (exact in f32
+    per the bound above).
     """
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    t = jnp.zeros((*shape, 2 * N_LIMBS), jnp.int32)
-    for i in range(N_LIMBS):
-        t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
-    return t
+    outer = (a[..., :, None] * b[..., None, :]).astype(jnp.float32)
+    outer = outer.reshape(*outer.shape[:-2], N_LIMBS * N_LIMBS)
+    # precision=HIGHEST: on TPU the default f32 matmul runs as bf16 MXU
+    # passes, which destroys integer exactness; HIGHEST forces full-f32
+    # accumulation, which is exact for our < 2^24 column sums.
+    t = jnp.einsum("...i,ik->...k", outer, CONV_MAT, precision=jax.lax.Precision.HIGHEST)
+    return jnp.round(t).astype(jnp.int32)
 
 
 def mont_mul(a, b):
@@ -157,21 +173,67 @@ def mont_mul(a, b):
     normalization. Closed on [0, 2p): for R = 2^384 and a,b < 2p the output
     (a*b + m_total*p)/R < (4p^2 + R*p)/R < 2p.
 
+    The digit fold is a lax.scan with a rolling window: each step consumes
+    the current lowest limb (which becomes an exact multiple of 2^8 and is
+    discarded — the division by R happening digit-wise) and rolls the array
+    left, so the updated window is static. This keeps the traced graph ~50
+    ops instead of ~150 per unrolled fold, which is what makes scan-heavy
+    callers (Miller loop, Fermat inversion) compile in reasonable time.
+
     This is the single hot primitive of the whole framework — the Pallas/MXU
     kernel will replace exactly this function.
     """
     t = _conv_schoolbook(a, b)
-    for i in range(N_LIMBS):
-        m = (t[..., i] * NINV8) & LIMB_MASK
-        t = t.at[..., i : i + N_LIMBS].add(m[..., None] * P_LIMBS)
-        t = t.at[..., i + 1].add(t[..., i] >> LIMB_BITS)
-    hi = t[..., N_LIMBS:]
-    out, _ = _carry_scan(hi)
+
+    def step(t, _):
+        m = (t[..., 0] * NINV8) & LIMB_MASK
+        t = t.at[..., :N_LIMBS].add(m[..., None] * P_LIMBS)
+        t = t.at[..., 1].add(t[..., 0] >> LIMB_BITS)
+        t = t.at[..., 0].set(0)
+        return jnp.roll(t, -1, axis=-1), None
+
+    t, _ = jax.lax.scan(step, t, None, length=N_LIMBS)
+    out, _ = _carry_scan(t[..., :N_LIMBS])
     return out
 
 
 def mont_sqr(a):
     return mont_mul(a, a)
+
+
+def mont_pow_const(a, e: int):
+    """a^e in the Montgomery domain for a *compile-time constant* exponent.
+
+    Left-to-right square-and-multiply as a lax.scan over the constant bit
+    string (MSB first): graph size is one loop body (2 mont_muls) regardless
+    of exponent width. Both branches are computed each step; the select is
+    per-batch-element free.
+    """
+    if e < 0:
+        raise ValueError("negative exponent")
+    if e == 0:
+        return jnp.broadcast_to(R_LIMBS, a.shape)
+    bits = jnp.asarray([int(b) for b in bin(e)[2:]], jnp.int32)
+
+    def step(acc, bit):
+        acc = mont_sqr(acc)
+        acc = jnp.where(bit == 1, mont_mul(acc, a), acc)
+        return acc, None
+
+    # First bit is always 1: start from a itself, scan the rest.
+    acc, _ = jax.lax.scan(step, a, bits[1:])
+    return acc
+
+
+def mont_inv(a):
+    """a^{-1} in the Montgomery domain via Fermat (a^(p-2)); 0 -> 0.
+
+    ~760 sequential mont_muls as one compiled scan; batched over all leading
+    axes, so cost amortizes across the batch. Used only where projective
+    coordinates can't absorb the division (final exponentiation, affine
+    normalization at serialization boundaries).
+    """
+    return mont_pow_const(a, P - 2)
 
 
 def to_mont(a):
