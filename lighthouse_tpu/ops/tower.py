@@ -1,0 +1,323 @@
+"""Batched BLS12-381 tower-field arithmetic (Fp2 / Fp6 / Fp12) for TPU.
+
+Device-side mirror of the pure-Python oracle tower
+(lighthouse_tpu/crypto/bls/fields.py — same construction:
+Fp2 = Fp[u]/(u^2+1), Fp6 = Fp2[v]/(v^3 - (1+u)), Fp12 = Fp6[w]/(w^2 - v)),
+re-expressed over the limb engine in lighthouse_tpu/ops/limb.py. The
+reference client gets this arithmetic from blst's C/assembly (reference:
+crypto/bls/src/impls/blst.rs); here it is batched JAX so XLA can vectorize
+a whole verification batch per op.
+
+Representation
+--------------
+Montgomery-form limb tensors with coefficient axes *stacked ahead of* the
+limb axis:
+
+    Fp   : int32[..., 48]
+    Fp2  : int32[..., 2, 48]          (c0, c1)
+    Fp6  : int32[..., 3, 2, 48]       (c0, c1, c2 — each Fp2)
+    Fp12 : int32[..., 2, 3, 2, 48]    (c0, c1 — each Fp6)
+
+Every limb-level primitive broadcasts over leading axes, so the key
+performance idiom of this module is *multiplication stacking*: all
+independent Fp products of a tower multiplication are gathered onto one
+leading axis and issued as a single mont_mul call — a full Fp12 multiply
+is one Montgomery pass over an [..., 18, 3, 48]-shaped operand rather than
+54 sequential muls. Sequential depth of any tower op ~= depth of one
+mont_mul.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..crypto.bls.constants import P
+from . import limb
+from .limb import add, double, mont_inv, mont_mul, neg, sub
+
+# ------------------------------------------------------------- host helpers
+
+
+def fp_to_dev(x: int) -> np.ndarray:
+    """Host int (standard domain) -> Montgomery-form limb vector [48]."""
+    return limb.int_to_limbs((x % P) * limb.R_MONT % P)
+
+
+def fp_from_dev(a) -> int:
+    """Montgomery-form limbs -> host int in [0, p)."""
+    v = limb.limbs_to_int(np.asarray(a))
+    return v * pow(limb.R_MONT, -1, P) % P
+
+
+def fp2_to_dev(c0: int, c1: int) -> np.ndarray:
+    return np.stack([fp_to_dev(c0), fp_to_dev(c1)])
+
+
+def fp2_from_dev(a) -> tuple[int, int]:
+    a = np.asarray(a)
+    return (fp_from_dev(a[..., 0, :]), fp_from_dev(a[..., 1, :]))
+
+
+def fp6_to_dev(coeffs) -> np.ndarray:
+    """coeffs: three (c0, c1) int pairs."""
+    return np.stack([fp2_to_dev(*c) for c in coeffs])
+
+
+def fp12_to_dev(c0_coeffs, c1_coeffs) -> np.ndarray:
+    return np.stack([fp6_to_dev(c0_coeffs), fp6_to_dev(c1_coeffs)])
+
+
+def fq2_to_dev(x) -> np.ndarray:
+    """Oracle Fq2 -> device tensor."""
+    return fp2_to_dev(x.c0, x.c1)
+
+
+def fq12_to_dev(f) -> np.ndarray:
+    """Oracle Fq12 -> device tensor [2, 3, 2, 48]."""
+    return fp12_to_dev(
+        [(x.c0, x.c1) for x in (f.c0.c0, f.c0.c1, f.c0.c2)],
+        [(x.c0, x.c1) for x in (f.c1.c0, f.c1.c1, f.c1.c2)],
+    )
+
+
+def fq12_from_dev(a):
+    """Device tensor -> oracle Fq12 (host, for tests/debug)."""
+    from ..crypto.bls.fields import Fq2, Fq6, Fq12
+
+    a = np.asarray(a)
+
+    def fq6(b):
+        return Fq6(*[Fq2(*fp2_from_dev(b[i])) for i in range(3)])
+
+    return Fq12(fq6(a[0]), fq6(a[1]))
+
+
+# --------------------------------------------------------------- constants
+
+def _c2(i: int) -> tuple:
+    from ..crypto.bls.fields import _FROB6_C1, _FROB6_C2, _FROB12_C1
+
+    return (_FROB6_C1, _FROB6_C2, _FROB12_C1)[i]
+
+
+FROB6_C1 = jnp.asarray(fq2_to_dev(_c2(0)))   # xi^((p-1)/3)
+FROB6_C2 = jnp.asarray(fq2_to_dev(_c2(1)))   # xi^(2(p-1)/3)
+FROB12_C1 = jnp.asarray(fq2_to_dev(_c2(2)))  # xi^((p-1)/6)
+
+FP2_ZERO = jnp.asarray(fp2_to_dev(0, 0))
+FP2_ONE = jnp.asarray(fp2_to_dev(1, 0))
+FP12_ONE = jnp.asarray(
+    fp12_to_dev([(1, 0), (0, 0), (0, 0)], [(0, 0), (0, 0), (0, 0)])
+)
+
+
+def _stk2(*xs):
+    """Stack Fp2 elements: new axis just before the (coeff, limb) axes."""
+    return jnp.stack(xs, axis=-3)
+
+
+def _stk6(*xs):
+    """Stack Fp6 elements: new axis just before the (v, coeff, limb) axes."""
+    return jnp.stack(xs, axis=-4)
+
+
+# --------------------------------------------------------------------- Fp2
+# Elementwise ops (add/sub/neg/double) are inherited directly from the limb
+# layer — they act on the trailing limb axis and broadcast over (c0, c1).
+
+fp2_add = add
+fp2_sub = sub
+fp2_neg = neg
+fp2_double = double
+
+
+def fp2_mul(a, b):
+    """Karatsuba: one stacked mont_mul of 3 products."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t = mont_mul(
+        jnp.stack([a0, a1, add(a0, a1)], axis=-2),
+        jnp.stack([b0, b1, add(b0, b1)], axis=-2),
+    )
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    return jnp.stack([sub(t0, t1), sub(sub(t2, t0), t1)], axis=-2)
+
+
+def fp2_sqr(a):
+    """(a0+a1)(a0-a1) + 2*a0*a1*u: one stacked mont_mul of 2 products."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    t = mont_mul(
+        jnp.stack([add(a0, a1), a0], axis=-2),
+        jnp.stack([sub(a0, a1), a1], axis=-2),
+    )
+    return jnp.stack([t[..., 0, :], double(t[..., 1, :])], axis=-2)
+
+
+def fp2_mul_fp(a, k):
+    """Fp2 * Fp (k: [..., 48], broadcast over the coefficient axis)."""
+    return mont_mul(a, k[..., None, :])
+
+
+def fp2_mul_by_xi(a):
+    """Multiply by xi = 1 + u: (c0 - c1, c0 + c1)."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([sub(a0, a1), add(a0, a1)], axis=-2)
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :], neg(a[..., 1, :])], axis=-2)
+
+
+def fp2_triple(a):
+    return add(double(a), a)
+
+
+def fp2_inv(a):
+    """1/(c0 + c1 u) = (c0 - c1 u) / (c0^2 + c1^2); 0 -> 0."""
+    s = mont_mul(a, a)  # (c0^2, c1^2) stacked for free on the coeff axis
+    norm_inv = mont_inv(add(s[..., 0, :], s[..., 1, :]))
+    return jnp.stack(
+        [
+            mont_mul(a[..., 0, :], norm_inv),
+            mont_mul(neg(a[..., 1, :]), norm_inv),
+        ],
+        axis=-2,
+    )
+
+
+def fp2_is_zero(a):
+    return jnp.logical_and(
+        limb.is_zero(a[..., 0, :]), limb.is_zero(a[..., 1, :])
+    )
+
+
+def fp2_eq(a, b):
+    return jnp.logical_and(
+        limb.eq(a[..., 0, :], b[..., 0, :]), limb.eq(a[..., 1, :], b[..., 1, :])
+    )
+
+
+# --------------------------------------------------------------------- Fp6
+
+fp6_add = add
+fp6_sub = sub
+fp6_neg = neg
+
+
+def _fp6_c(a, i):
+    return a[..., i, :, :]
+
+
+def fp6_mul(a, b):
+    """Toom/Karatsuba 6-product schedule, one stacked fp2_mul."""
+    a0, a1, a2 = (_fp6_c(a, i) for i in range(3))
+    b0, b1, b2 = (_fp6_c(b, i) for i in range(3))
+    x = _stk2(a0, a1, a2, add(a1, a2), add(a0, a1), add(a0, a2))
+    y = _stk2(b0, b1, b2, add(b1, b2), add(b0, b1), add(b0, b2))
+    t = fp2_mul(x, y)
+    t0, t1, t2, s12, s01, s02 = (t[..., i, :, :] for i in range(6))
+    c0 = add(fp2_mul_by_xi(sub(sub(s12, t1), t2)), t0)
+    c1 = add(sub(sub(s01, t0), t1), fp2_mul_by_xi(t2))
+    c2 = add(sub(sub(s02, t0), t2), t1)
+    return _stk2(c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    """(c0, c1, c2) -> (xi*c2, c0, c1)."""
+    return _stk2(fp2_mul_by_xi(_fp6_c(a, 2)), _fp6_c(a, 0), _fp6_c(a, 1))
+
+
+def fp6_mul_fp2(a, k):
+    """Fp6 * Fp2 (k broadcast over the v-coefficient axis)."""
+    return fp2_mul(a, k[..., None, :, :])
+
+
+def fp6_inv(a):
+    """Oracle formula (fields.py Fq6.inv), stacked: 6 + 3 + 3 products."""
+    c0, c1, c2 = (_fp6_c(a, i) for i in range(3))
+    m = fp2_mul(_stk2(c0, c1, c2, c0, c1, c0), _stk2(c0, c2, c2, c1, c1, c2))
+    a_sq, bc, c_sq, ab, b_sq, ac = (m[..., i, :, :] for i in range(6))
+    t0 = sub(a_sq, fp2_mul_by_xi(bc))
+    t1 = sub(fp2_mul_by_xi(c_sq), ab)
+    t2 = sub(b_sq, ac)
+    n = fp2_mul(_stk2(c0, c2, c1), _stk2(t0, t1, t2))
+    denom = add(n[..., 0, :, :], fp2_mul_by_xi(add(n[..., 1, :, :], n[..., 2, :, :])))
+    d_inv = fp2_inv(denom)
+    return fp2_mul(_stk2(t0, t1, t2), d_inv[..., None, :, :])
+
+
+def fp6_frobenius(a):
+    c = fp2_conj(a)
+    return _stk2(
+        c[..., 0, :, :],
+        fp2_mul(c[..., 1, :, :], FROB6_C1),
+        fp2_mul(c[..., 2, :, :], FROB6_C2),
+    )
+
+
+# -------------------------------------------------------------------- Fp12
+
+fp12_add = add
+fp12_sub = sub
+
+
+def _w(a, i):
+    return a[..., i, :, :, :]
+
+
+def fp12_mul(a, b):
+    """Karatsuba over Fp6: one stacked fp6_mul of 3 products."""
+    a0, a1 = _w(a, 0), _w(a, 1)
+    b0, b1 = _w(b, 0), _w(b, 1)
+    t = fp6_mul(_stk6(a0, a1, add(a0, a1)), _stk6(b0, b1, add(b0, b1)))
+    t0, t1, s = (t[..., i, :, :, :] for i in range(3))
+    c0 = add(t0, fp6_mul_by_v(t1))
+    c1 = sub(sub(s, t0), t1)
+    return _stk6(c0, c1)
+
+
+def fp12_sqr(a):
+    """Oracle formula: c0=(a0+a1)(a0+v a1)-t0-v t0, c1=2 t0, t0=a0*a1."""
+    a0, a1 = _w(a, 0), _w(a, 1)
+    t = fp6_mul(_stk6(a0, add(a0, a1)), _stk6(a1, add(a0, fp6_mul_by_v(a1))))
+    t0, s = t[..., 0, :, :, :], t[..., 1, :, :, :]
+    c0 = sub(sub(s, t0), fp6_mul_by_v(t0))
+    c1 = double(t0)
+    return _stk6(c0, c1)
+
+
+def fp12_conj(a):
+    """Conjugation over Fp6 (= raising to p^6, cyclotomic inverse)."""
+    return _stk6(_w(a, 0), fp6_neg(_w(a, 1)))
+
+
+def fp12_inv(a):
+    a0, a1 = _w(a, 0), _w(a, 1)
+    s = fp6_mul(_stk6(a0, a1), _stk6(a0, a1))  # squares, stacked
+    denom = sub(s[..., 0, :, :, :], fp6_mul_by_v(s[..., 1, :, :, :]))
+    d_inv = fp6_inv(denom)
+    o = fp6_mul(_stk6(a0, a1), _stk6(d_inv, d_inv))
+    return _stk6(o[..., 0, :, :, :], fp6_neg(o[..., 1, :, :, :]))
+
+
+def fp12_frobenius(a):
+    c0 = fp6_frobenius(_w(a, 0))
+    c1 = fp6_mul_fp2(fp6_frobenius(_w(a, 1)), FROB12_C1)
+    return _stk6(c0, c1)
+
+
+def fp12_frobenius2(a):
+    return fp12_frobenius(fp12_frobenius(a))
+
+
+def fp12_eq(a, b):
+    return jnp.all(limb.eq(a, b), axis=(-3, -2, -1))
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, FP12_ONE)

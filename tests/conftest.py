@@ -1,16 +1,33 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths are
-exercised without TPU hardware (the driver dry-runs the real multi-chip path
-separately via __graft_entry__.dryrun_multichip). Must run before any jax
-import, hence the env mutation at module import time.
+Forces JAX onto a virtual 8-device CPU mesh so (a) compiles are fast enough
+to property-test every kernel against the big-int oracle, and (b) multi-chip
+sharding paths are exercised without TPU hardware. The driver separately
+compile-checks the real single-chip and multi-chip paths via
+__graft_entry__.entry / dryrun_multichip, and bench.py re-validates kernel
+exactness on the real chip before timing (the one true TPU-specific hazard —
+default-precision f32 matmuls running as bf16 MXU passes — is pinned there
+and in ops/limb.py).
+
+Platform selection must happen via jax.config (not env vars): the image's
+sitecustomize force-registers the TPU tunnel platform and overrides
+JAX_PLATFORMS, but backend *initialization* is lazy, so flipping the config
+knob before the first backend use keeps the whole suite on CPU.
+
+Set LIGHTHOUSE_TPU_TEST_PLATFORM to run the suite elsewhere (e.g. "axon"
+for hardware).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after XLA_FLAGS so the CPU client sees it)
+
+jax.config.update(
+    "jax_platforms", os.environ.get("LIGHTHOUSE_TPU_TEST_PLATFORM", "cpu")
+)
