@@ -31,3 +31,8 @@ import jax  # noqa: E402  (after XLA_FLAGS so the CPU client sees it)
 jax.config.update(
     "jax_platforms", os.environ.get("LIGHTHOUSE_TPU_TEST_PLATFORM", "cpu")
 )
+
+# Persistent compilation cache: the pairing pipeline compiles in ~minutes on
+# CPU; caching makes re-runs of the suite start hot.
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
