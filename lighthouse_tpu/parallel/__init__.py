@@ -1,0 +1,5 @@
+"""Multi-chip parallelism: mesh construction + sharded batch verification."""
+
+from .sharding import build_sharded_verifier, make_mesh
+
+__all__ = ["build_sharded_verifier", "make_mesh"]

@@ -1,0 +1,9 @@
+"""Small shared utilities."""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 0; returns 1 for n <= 1)."""
+    m = 1
+    while m < n:
+        m <<= 1
+    return m

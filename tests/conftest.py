@@ -34,5 +34,8 @@ jax.config.update(
 
 # Persistent compilation cache: the pairing pipeline compiles in ~minutes on
 # CPU; caching makes re-runs of the suite start hot.
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)

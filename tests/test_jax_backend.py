@@ -1,0 +1,64 @@
+"""End-to-end tests for the JAX device backend's verify_signature_sets.
+
+Oracle parity: the same set lists are checked against the pure-Python RLC
+path (api.verify_signature_sets_python). All device cases share one (S, K)
+bucket so the suite pays exactly one compile of the verify program.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.api import (
+    AggregateSignature,
+    SecretKey,
+    SignatureSet,
+    verify_signature_sets,
+    verify_signature_sets_python,
+)
+from lighthouse_tpu.crypto.bls.backends import get_backend
+
+
+SKS = [SecretKey.from_int(i + 7) for i in range(3)]
+PKS = [sk.public_key() for sk in SKS]
+M0 = b"\x11" * 32
+M1 = b"\x22" * 32
+
+
+def _valid_sets():
+    s0 = SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[0], M0)
+    agg = AggregateSignature.aggregate([SKS[1].sign(M1), SKS[2].sign(M1)])
+    s1 = SignatureSet.multiple_pubkeys(agg, [PKS[1], PKS[2]], M1)
+    return [s0, s1]
+
+
+def test_device_accepts_valid_batch():
+    sets = _valid_sets()
+    assert verify_signature_sets_python(sets)
+    assert get_backend("jax").verify_signature_sets(sets)
+
+
+def test_device_rejects_wrong_message():
+    sets = _valid_sets()
+    sets[0] = SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[0], M1)
+    assert not verify_signature_sets_python(sets)
+    assert not get_backend("jax").verify_signature_sets(sets)
+
+
+def test_device_rejects_wrong_key():
+    sets = _valid_sets()
+    sets[0] = SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[1], M0)
+    assert not get_backend("jax").verify_signature_sets(sets)
+
+
+def test_structural_rejections_host_side():
+    be = get_backend("jax")
+    assert not be.verify_signature_sets([])
+    s = SignatureSet(AggregateSignature.infinity(), [PKS[0]], M0)
+    assert not be.verify_signature_sets([s])  # infinity signature
+    s2 = SignatureSet(AggregateSignature.aggregate([SKS[0].sign(M0)]), [], M0)
+    assert not be.verify_signature_sets([s2])  # no pubkeys
+
+
+def test_backend_dispatch():
+    sets = _valid_sets()
+    assert verify_signature_sets(sets, backend="jax")
+    assert verify_signature_sets(sets, backend="fake")

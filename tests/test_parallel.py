@@ -1,0 +1,83 @@
+"""Multi-chip (virtual 8-CPU-device mesh) tests for the sharded verifier
+and the driver entry points in __graft_entry__.py.
+
+Shapes here deliberately match dryrun_multichip(4) so the persistent
+compilation cache (conftest) shares compiles between the two tests.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet, AggregateSignature
+from lighthouse_tpu.crypto.bls.curve import g1_infinity
+from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.jax_backend import _rand_bits_array
+from lighthouse_tpu.ops.points import g1_to_dev, g2_to_dev
+from lighthouse_tpu.parallel import build_sharded_verifier, make_mesh
+
+
+def _flat_batch(sets, S, K):
+    """SignatureSets -> the flat array tuple the sharded verifier takes."""
+    inf1 = g1_infinity()
+    rows = []
+    for s in sets:
+        row = [pk.point for pk in s.signing_keys]
+        row += [inf1] * (K - len(row))
+        rows.append(row)
+    px, py, pinf = g1_to_dev([p for r in rows for p in r])
+    sx, sy, sinf = g2_to_dev([s.signature.point for s in sets])
+    mx, my, minf = g2_to_dev([hash_to_g2(s.message) for s in sets])
+    return (
+        px.reshape(S, K, 48), py.reshape(S, K, 48), pinf.reshape(S, K),
+        sx, sy, sinf, mx, my, minf, _rand_bits_array(S),
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+def test_sharded_verifier_matches_oracle():
+    S, K = 4, 4
+    sks = [SecretKey.from_int(i + 3) for i in range(5)]
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    sets = [
+        SignatureSet.single_pubkey(sks[0].sign(msgs[0]), sks[0].public_key(), msgs[0]),
+        SignatureSet.multiple_pubkeys(
+            AggregateSignature.aggregate([sks[1].sign(msgs[1]), sks[2].sign(msgs[1])]),
+            [sks[1].public_key(), sks[2].public_key()],
+            msgs[1],
+        ),
+        SignatureSet.single_pubkey(sks[3].sign(msgs[2]), sks[3].public_key(), msgs[2]),
+        SignatureSet.single_pubkey(sks[4].sign(msgs[3]), sks[4].public_key(), msgs[3]),
+    ]
+
+    mesh = make_mesh(4, mp=2)  # dp=2, mp=2
+    fn = jax.jit(build_sharded_verifier(mesh))
+
+    good = _flat_batch(sets, S, K)
+    assert bool(fn(*good)[0])
+
+    # Tamper: swap two signatures -> the RLC product can no longer be one.
+    bad = list(good)
+    sx = np.array(good[3])
+    sx[[0, 1]] = sx[[1, 0]]
+    bad[3] = sx
+    assert not bool(fn(*bad)[0])
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+def test_graft_dryrun_multichip():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(4)
+
+
+def test_graft_entry_shapes():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    # Don't compile here (test_jax_backend compiles the same program);
+    # just validate structure.
+    assert callable(fn)
+    (pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits) = args
+    assert pk[0].shape == (2, 2, 48) and r_bits.shape == (2, 64)
