@@ -1,0 +1,282 @@
+"""Spec presets and runtime chain configuration.
+
+Capability mirror of the reference's EthSpec compile-time presets
+(consensus/types/src/eth_spec.rs:51-91 — Mainnet/Minimal via typenum) and
+runtime ChainSpec (consensus/types/src/chain_spec.rs — domains, fork
+schedule, get_domain/compute_domain). Values are the public Ethereum
+consensus-spec constants (v1.1.x line: phase0 / altair / bellatrix).
+
+Here a ``Preset`` is a plain namespace of the compile-time-ish constants
+(container size parameters), and ``ChainSpec`` holds the runtime ones
+(fork versions/epochs, time parameters, domains).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .hashing import hash_bytes
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+GENESIS_EPOCH = 0
+GENESIS_SLOT = 0
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+# Altair participation flags (consensus-specs altair/beacon-chain.md).
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT,
+    TIMELY_TARGET_WEIGHT,
+    TIMELY_HEAD_WEIGHT,
+]
+
+
+@dataclass(frozen=True)
+class Preset:
+    """Size-parameter preset (reference: eth_spec.rs Mainnet/Minimal impls)."""
+
+    name: str
+    # Misc
+    MAX_COMMITTEES_PER_SLOT: int
+    TARGET_COMMITTEE_SIZE: int
+    MAX_VALIDATORS_PER_COMMITTEE: int
+    SHUFFLE_ROUND_COUNT: int
+    HYSTERESIS_QUOTIENT: int = 4
+    HYSTERESIS_DOWNWARD_MULTIPLIER: int = 1
+    HYSTERESIS_UPWARD_MULTIPLIER: int = 5
+    # Gwei
+    MIN_DEPOSIT_AMOUNT: int = 10**9
+    MAX_EFFECTIVE_BALANCE: int = 32 * 10**9
+    EFFECTIVE_BALANCE_INCREMENT: int = 10**9
+    # Time
+    MIN_ATTESTATION_INCLUSION_DELAY: int = 1
+    SLOTS_PER_EPOCH: int = 32
+    MIN_SEED_LOOKAHEAD: int = 1
+    MAX_SEED_LOOKAHEAD: int = 4
+    EPOCHS_PER_ETH1_VOTING_PERIOD: int = 64
+    SLOTS_PER_HISTORICAL_ROOT: int = 8192
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY: int = 256
+    SHARD_COMMITTEE_PERIOD: int = 256
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY: int = 4
+    # State vector lengths
+    EPOCHS_PER_HISTORICAL_VECTOR: int = 65536
+    EPOCHS_PER_SLASHINGS_VECTOR: int = 8192
+    HISTORICAL_ROOTS_LIMIT: int = 2**24
+    VALIDATOR_REGISTRY_LIMIT: int = 2**40
+    # Rewards/penalties (phase0; altair/bellatrix override some at runtime)
+    BASE_REWARD_FACTOR: int = 64
+    WHISTLEBLOWER_REWARD_QUOTIENT: int = 512
+    PROPOSER_REWARD_QUOTIENT: int = 8
+    INACTIVITY_PENALTY_QUOTIENT: int = 2**26
+    MIN_SLASHING_PENALTY_QUOTIENT: int = 128
+    PROPORTIONAL_SLASHING_MULTIPLIER: int = 1
+    # Max operations per block
+    MAX_PROPOSER_SLASHINGS: int = 16
+    MAX_ATTESTER_SLASHINGS: int = 2
+    MAX_ATTESTATIONS: int = 128
+    MAX_DEPOSITS: int = 16
+    MAX_VOLUNTARY_EXITS: int = 16
+    # Altair
+    INACTIVITY_PENALTY_QUOTIENT_ALTAIR: int = 3 * 2**24
+    MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR: int = 64
+    PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR: int = 2
+    SYNC_COMMITTEE_SIZE: int = 512
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD: int = 256
+    MIN_SYNC_COMMITTEE_PARTICIPANTS: int = 1
+    # Bellatrix (merge)
+    INACTIVITY_PENALTY_QUOTIENT_BELLATRIX: int = 2**24
+    MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX: int = 32
+    PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX: int = 3
+    MAX_BYTES_PER_TRANSACTION: int = 2**30
+    MAX_TRANSACTIONS_PER_PAYLOAD: int = 2**20
+    BYTES_PER_LOGS_BLOOM: int = 256
+    MAX_EXTRA_DATA_BYTES: int = 32
+
+
+MAINNET = Preset(
+    name="mainnet",
+    MAX_COMMITTEES_PER_SLOT=64,
+    TARGET_COMMITTEE_SIZE=128,
+    MAX_VALIDATORS_PER_COMMITTEE=2048,
+    SHUFFLE_ROUND_COUNT=90,
+)
+
+MINIMAL = Preset(
+    name="minimal",
+    MAX_COMMITTEES_PER_SLOT=4,
+    TARGET_COMMITTEE_SIZE=4,
+    MAX_VALIDATORS_PER_COMMITTEE=2048,
+    SHUFFLE_ROUND_COUNT=10,
+    SLOTS_PER_EPOCH=8,
+    EPOCHS_PER_ETH1_VOTING_PERIOD=4,
+    SLOTS_PER_HISTORICAL_ROOT=64,
+    SHARD_COMMITTEE_PERIOD=64,
+    EPOCHS_PER_HISTORICAL_VECTOR=64,
+    EPOCHS_PER_SLASHINGS_VECTOR=64,
+    SYNC_COMMITTEE_SIZE=32,
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
+)
+
+PRESETS = {"mainnet": MAINNET, "minimal": MINIMAL}
+
+
+# ------------------------------------------------------------------ ChainSpec
+
+
+@dataclass
+class ChainSpec:
+    """Runtime network configuration (reference: chain_spec.rs).
+
+    Fork schedule + time + churn + domains; `name` is the network name.
+    """
+
+    name: str = "mainnet"
+    preset: Preset = MAINNET
+
+    # Genesis
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: int = 16384
+    MIN_GENESIS_TIME: int = 1606824000
+    GENESIS_FORK_VERSION: bytes = b"\x00\x00\x00\x00"
+    GENESIS_DELAY: int = 604800
+    # Forks
+    ALTAIR_FORK_VERSION: bytes = b"\x01\x00\x00\x00"
+    ALTAIR_FORK_EPOCH: int | None = 74240
+    BELLATRIX_FORK_VERSION: bytes = b"\x02\x00\x00\x00"
+    BELLATRIX_FORK_EPOCH: int | None = 144896
+    # Time
+    SECONDS_PER_SLOT: int = 12
+    SECONDS_PER_ETH1_BLOCK: int = 14
+    ETH1_FOLLOW_DISTANCE: int = 2048
+    # Validator cycle
+    EJECTION_BALANCE: int = 16 * 10**9
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    CHURN_LIMIT_QUOTIENT: int = 2**16
+    # Fork choice
+    PROPOSER_SCORE_BOOST: int = 40
+    # Altair light-client/inactivity
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+    # Deposit contract
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_NETWORK_ID: int = 1
+    DEPOSIT_CONTRACT_ADDRESS: bytes = bytes(20)
+    # Merge transition
+    TERMINAL_TOTAL_DIFFICULTY: int = 58750000000000000000000
+    TERMINAL_BLOCK_HASH: bytes = bytes(32)
+    TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH: int = 2**64 - 1
+
+    # Domains (spec domain types, 4-byte little-endian ints).
+    DOMAIN_BEACON_PROPOSER: bytes = (0).to_bytes(4, "little")
+    DOMAIN_BEACON_ATTESTER: bytes = (1).to_bytes(4, "little")
+    DOMAIN_RANDAO: bytes = (2).to_bytes(4, "little")
+    DOMAIN_DEPOSIT: bytes = (3).to_bytes(4, "little")
+    DOMAIN_VOLUNTARY_EXIT: bytes = (4).to_bytes(4, "little")
+    DOMAIN_SELECTION_PROOF: bytes = (5).to_bytes(4, "little")
+    DOMAIN_AGGREGATE_AND_PROOF: bytes = (6).to_bytes(4, "little")
+    DOMAIN_SYNC_COMMITTEE: bytes = (7).to_bytes(4, "little")
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF: bytes = (8).to_bytes(4, "little")
+    DOMAIN_CONTRIBUTION_AND_PROOF: bytes = (9).to_bytes(4, "little")
+
+    # -- fork schedule -------------------------------------------------------
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        if self.BELLATRIX_FORK_EPOCH is not None and epoch >= self.BELLATRIX_FORK_EPOCH:
+            return "bellatrix"
+        if self.ALTAIR_FORK_EPOCH is not None and epoch >= self.ALTAIR_FORK_EPOCH:
+            return "altair"
+        return "phase0"
+
+    def fork_version_for_name(self, fork_name: str) -> bytes:
+        return {
+            "phase0": self.GENESIS_FORK_VERSION,
+            "altair": self.ALTAIR_FORK_VERSION,
+            "bellatrix": self.BELLATRIX_FORK_VERSION,
+        }[fork_name]
+
+    def fork_version_at_epoch(self, epoch: int) -> bytes:
+        return self.fork_version_for_name(self.fork_name_at_epoch(epoch))
+
+    # -- domains (reference: chain_spec.rs:343,410) --------------------------
+    def compute_fork_data_root(
+        self, current_version: bytes, genesis_validators_root: bytes
+    ) -> bytes:
+        from .ssz import Bytes4, Bytes32, merkleize_chunks
+
+        return merkleize_chunks(
+            [
+                Bytes4.hash_tree_root(current_version),
+                Bytes32.hash_tree_root(genesis_validators_root),
+            ]
+        )
+
+    def compute_fork_digest(
+        self, current_version: bytes, genesis_validators_root: bytes
+    ) -> bytes:
+        return self.compute_fork_data_root(
+            current_version, genesis_validators_root
+        )[:4]
+
+    def compute_domain(
+        self,
+        domain_type: bytes,
+        fork_version: bytes | None = None,
+        genesis_validators_root: bytes = bytes(32),
+    ) -> bytes:
+        if fork_version is None:
+            fork_version = self.GENESIS_FORK_VERSION
+        root = self.compute_fork_data_root(fork_version, genesis_validators_root)
+        return domain_type + root[:28]
+
+    def get_domain(
+        self,
+        domain_type: bytes,
+        epoch: int,
+        fork,
+        genesis_validators_root: bytes,
+    ) -> bytes:
+        """Domain for ``epoch`` under ``fork`` (a types.Fork container)."""
+        version = (
+            fork.previous_version if epoch < fork.epoch else fork.current_version
+        )
+        return self.compute_domain(domain_type, version, genesis_validators_root)
+
+    # -- helpers -------------------------------------------------------------
+    def min_genesis_delay(self) -> int:
+        return self.GENESIS_DELAY
+
+
+def compute_signing_root(obj, domain: bytes) -> bytes:
+    """hash_tree_root(SigningData{object_root, domain}) (reference:
+    consensus/types/src/signing_data.rs:12)."""
+    from .ssz import merkleize_chunks
+
+    return merkleize_chunks([obj.hash_tree_root(), domain])
+
+
+def mainnet_spec() -> ChainSpec:
+    return ChainSpec()
+
+
+def minimal_spec() -> ChainSpec:
+    return ChainSpec(
+        name="minimal",
+        preset=MINIMAL,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=64,
+        ETH1_FOLLOW_DISTANCE=16,
+        GENESIS_DELAY=300,
+        SECONDS_PER_SLOT=6,
+        CHURN_LIMIT_QUOTIENT=32,
+        # Minimal networks schedule forks per-test (reference: the harness's
+        # fork_from_env); disabled until a test sets them.
+        ALTAIR_FORK_EPOCH=None,
+        BELLATRIX_FORK_EPOCH=None,
+    )

@@ -1,0 +1,44 @@
+"""SHA-256 hashing for consensus objects.
+
+Capability mirror of the reference's eth2_hashing crate
+(crypto/eth2_hashing/src/lib.rs:20-37: ``hash``, ``hash_fixed``,
+``hash32_concat``, and the lazy ``ZERO_HASHES`` zero-subtree cache). The
+reference selects sha2/ring by CPU feature at runtime; here hashlib's
+OpenSSL SHA-256 (SHA-NI accelerated where available) is the host path.
+Tree-hashing at scale is a later TPU-offload candidate (SURVEY §2.6 item 2);
+the consensus layer only depends on this seam.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+HASH_LEN = 32
+
+# Depth of the deepest merkle tree the spec ever materializes (validator
+# registry limit is 2^40; 64 matches the reference's ZERO_HASHES_MAX_INDEX).
+ZERO_HASHES_MAX_INDEX = 64
+
+
+def hash_bytes(data: bytes) -> bytes:
+    """SHA-256 digest (reference: eth2_hashing ``hash``)."""
+    return hashlib.sha256(data).digest()
+
+
+def hash32_concat(a: bytes, b: bytes) -> bytes:
+    """SHA-256(a ‖ b) for two 32-byte inputs — the merkle combiner."""
+    h = hashlib.sha256()
+    h.update(a)
+    h.update(b)
+    return h.digest()
+
+
+def _build_zero_hashes() -> list[bytes]:
+    out = [b"\x00" * HASH_LEN]
+    for _ in range(ZERO_HASHES_MAX_INDEX):
+        out.append(hash32_concat(out[-1], out[-1]))
+    return out
+
+
+# ZERO_HASHES[i] = root of a depth-i tree of zero leaves.
+ZERO_HASHES: list[bytes] = _build_zero_hashes()

@@ -1,0 +1,521 @@
+"""SSZ: SimpleSerialize encoding/decoding + merkleization (hash_tree_root).
+
+Capability mirror of the reference's consensus/ssz, ssz_types, tree_hash and
+their derive macros (reference: consensus/ssz/src/lib.rs, ssz_types/src/
+bitfield.rs:20-39, tree_hash/src/lib.rs), collapsed into one Python module:
+where Rust uses derive macros over structs, this uses *schema descriptors* —
+small objects that know how to encode/decode/default/hash a Python value —
+and a ``Container`` base class that reads a class-level ``fields`` table.
+
+Supported types (everything the phase0/altair/merge containers need):
+  uintN (8..256), boolean, ByteVector[N] (Bytes4/32/48/96), ByteList[N],
+  Vector[T, N], List[T, N], Bitvector[N], Bitlist[N], Container.
+
+Merkleization follows the spec: pack basic values into 32-byte chunks,
+merkleize with a chunk-count limit (virtual zero-padding via the
+ZERO_HASHES cache), mix in length for lists/bitlists.
+"""
+
+from __future__ import annotations
+
+from .hashing import ZERO_HASHES, hash32_concat
+
+BYTES_PER_CHUNK = 32
+OFFSET_LEN = 4
+
+
+class SszError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------- merkle core
+
+
+from ..utils import next_pow2 as _next_pow2
+
+
+def merkleize_chunks(chunks: list[bytes], limit: int | None = None) -> bytes:
+    """Merkle root of 32-byte chunks, zero-padded to ``limit`` leaves.
+
+    ``limit=None`` pads to the next power of two of len(chunks) (vectors /
+    containers); a list passes its maximum chunk count so empty/short lists
+    still get full-depth roots (spec ``merkleize(chunks, limit)``).
+    """
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise SszError("chunk count exceeds limit")
+    width = _next_pow2(max(limit, 1))
+    depth = width.bit_length() - 1
+
+    layer = list(chunks)
+    for d in range(depth):
+        nxt = []
+        odd = len(layer) & 1
+        for i in range(0, len(layer) - odd, 2):
+            nxt.append(hash32_concat(layer[i], layer[i + 1]))
+        if odd:
+            nxt.append(hash32_concat(layer[-1], ZERO_HASHES[d]))
+        layer = nxt or [ZERO_HASHES[d + 1]]
+    return layer[0] if layer else ZERO_HASHES[depth]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash32_concat(root, length.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> list[bytes]:
+    """Right-zero-pad ``data`` to whole 32-byte chunks."""
+    if not data:
+        return []
+    pad = (-len(data)) % BYTES_PER_CHUNK
+    data = data + b"\x00" * pad
+    return [data[i : i + 32] for i in range(0, len(data), 32)]
+
+
+# ------------------------------------------------------------------- schemas
+
+
+class SszType:
+    """Base schema descriptor. Subclasses define:
+    is_fixed, fixed_len (if fixed), default(), encode(v), decode(bytes),
+    hash_tree_root(v)."""
+
+    is_fixed = True
+    fixed_len = 0
+
+    def default(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def encode(self, v) -> bytes:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def decode(self, data: bytes):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def hash_tree_root(self, v) -> bytes:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Uint(SszType):
+    def __init__(self, byte_len: int):
+        self.fixed_len = byte_len
+        self.bits = byte_len * 8
+
+    def default(self):
+        return 0
+
+    def encode(self, v) -> bytes:
+        return int(v).to_bytes(self.fixed_len, "little")
+
+    def decode(self, data: bytes):
+        if len(data) != self.fixed_len:
+            raise SszError(f"uint{self.bits}: bad length {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, v) -> bytes:
+        return self.encode(v).ljust(32, b"\x00")
+
+
+class Boolean(SszType):
+    fixed_len = 1
+
+    def default(self):
+        return False
+
+    def encode(self, v) -> bytes:
+        return b"\x01" if v else b"\x00"
+
+    def decode(self, data: bytes):
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise SszError("invalid boolean byte")
+
+    def hash_tree_root(self, v) -> bytes:
+        return self.encode(v).ljust(32, b"\x00")
+
+
+uint8 = Uint(1)
+uint16 = Uint(2)
+uint32 = Uint(4)
+uint64 = Uint(8)
+uint128 = Uint(16)
+uint256 = Uint(32)
+boolean = Boolean()
+
+
+class ByteVector(SszType):
+    """Fixed-length opaque bytes (Bytes4 / Bytes20 / Bytes32 / Bytes48 / Bytes96)."""
+
+    def __init__(self, length: int):
+        self.fixed_len = length
+
+    def default(self):
+        return b"\x00" * self.fixed_len
+
+    def encode(self, v) -> bytes:
+        if len(v) != self.fixed_len:
+            raise SszError(f"ByteVector[{self.fixed_len}]: bad length {len(v)}")
+        return bytes(v)
+
+    def decode(self, data: bytes):
+        if len(data) != self.fixed_len:
+            raise SszError(f"ByteVector[{self.fixed_len}]: bad length {len(data)}")
+        return bytes(data)
+
+    def hash_tree_root(self, v) -> bytes:
+        return merkleize_chunks(pack_bytes(self.encode(v)))
+
+
+Bytes4 = ByteVector(4)
+Bytes20 = ByteVector(20)
+Bytes32 = ByteVector(32)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)
+
+
+class ByteList(SszType):
+    """Variable-length bytes with a max length (ExecutionPayload data fields)."""
+
+    is_fixed = False
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def default(self):
+        return b""
+
+    def encode(self, v) -> bytes:
+        if len(v) > self.limit:
+            raise SszError("ByteList over limit")
+        return bytes(v)
+
+    def decode(self, data: bytes):
+        if len(data) > self.limit:
+            raise SszError("ByteList over limit")
+        return bytes(data)
+
+    def hash_tree_root(self, v) -> bytes:
+        limit_chunks = (self.limit + 31) // 32
+        return mix_in_length(
+            merkleize_chunks(pack_bytes(bytes(v)), limit_chunks), len(v)
+        )
+
+
+class Vector(SszType):
+    def __init__(self, elem: SszType, length: int):
+        if length <= 0:
+            raise SszError("Vector length must be positive")
+        self.elem = elem
+        self.length = length
+        self.is_fixed = elem.is_fixed
+        self.fixed_len = elem.fixed_len * length if elem.is_fixed else 0
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+    def encode(self, v) -> bytes:
+        if len(v) != self.length:
+            raise SszError(f"Vector[{self.length}]: bad length {len(v)}")
+        return _encode_sequence([self.elem] * self.length, list(v))
+
+    def decode(self, data: bytes):
+        out = _decode_homogeneous(self.elem, data)
+        if len(out) != self.length:
+            raise SszError(f"Vector[{self.length}]: decoded {len(out)}")
+        return out
+
+    def hash_tree_root(self, v) -> bytes:
+        if isinstance(self.elem, (Uint, Boolean)):
+            packed = b"".join(self.elem.encode(x) for x in v)
+            return merkleize_chunks(pack_bytes(packed))
+        return merkleize_chunks([self.elem.hash_tree_root(x) for x in v])
+
+
+class List(SszType):
+    is_fixed = False
+
+    def __init__(self, elem: SszType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def default(self):
+        return []
+
+    def encode(self, v) -> bytes:
+        if len(v) > self.limit:
+            raise SszError("List over limit")
+        return _encode_sequence([self.elem] * len(v), list(v))
+
+    def decode(self, data: bytes):
+        out = _decode_homogeneous(self.elem, data)
+        if len(out) > self.limit:
+            raise SszError("List over limit")
+        return out
+
+    def hash_tree_root(self, v) -> bytes:
+        if isinstance(self.elem, (Uint, Boolean)):
+            packed = b"".join(self.elem.encode(x) for x in v)
+            limit_chunks = (self.limit * self.elem.fixed_len + 31) // 32
+            root = merkleize_chunks(pack_bytes(packed), limit_chunks)
+        else:
+            root = merkleize_chunks(
+                [self.elem.hash_tree_root(x) for x in v], self.limit
+            )
+        return mix_in_length(root, len(v))
+
+
+class Bitvector(SszType):
+    """Fixed-width bitfield; value is a list[bool] of exactly N bits
+    (reference: ssz_types/src/bitfield.rs BitVector)."""
+
+    def __init__(self, length: int):
+        if length <= 0:
+            raise SszError("Bitvector length must be positive")
+        self.length = length
+        self.fixed_len = (length + 7) // 8
+
+    def default(self):
+        return [False] * self.length
+
+    def encode(self, v) -> bytes:
+        if len(v) != self.length:
+            raise SszError("Bitvector: bad length")
+        out = bytearray(self.fixed_len)
+        for i, bit in enumerate(v):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+
+    def decode(self, data: bytes):
+        if len(data) != self.fixed_len:
+            raise SszError("Bitvector: bad byte length")
+        # Excess bits beyond N must be zero.
+        if self.length % 8:
+            if data[-1] >> (self.length % 8):
+                raise SszError("Bitvector: high bits set")
+        return [bool(data[i // 8] >> (i % 8) & 1) for i in range(self.length)]
+
+    def hash_tree_root(self, v) -> bytes:
+        return merkleize_chunks(pack_bytes(self.encode(v)))
+
+
+class Bitlist(SszType):
+    """Variable-length bitfield with max length; value is list[bool].
+    Serialized with a trailing delimiter bit (reference: bitfield.rs BitList)."""
+
+    is_fixed = False
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def default(self):
+        return []
+
+    def encode(self, v) -> bytes:
+        if len(v) > self.limit:
+            raise SszError("Bitlist over limit")
+        n = len(v)
+        out = bytearray(n // 8 + 1)
+        for i, bit in enumerate(v):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        out[n // 8] |= 1 << (n % 8)  # delimiter
+        return bytes(out)
+
+    def decode(self, data: bytes):
+        if not data:
+            raise SszError("Bitlist: empty")
+        last = data[-1]
+        if last == 0:
+            raise SszError("Bitlist: missing delimiter")
+        n = (len(data) - 1) * 8 + last.bit_length() - 1
+        if n > self.limit:
+            raise SszError("Bitlist over limit")
+        return [bool(data[i // 8] >> (i % 8) & 1) for i in range(n)]
+
+    def hash_tree_root(self, v) -> bytes:
+        n = len(v)
+        out = bytearray((n + 7) // 8)
+        for i, bit in enumerate(v):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        limit_chunks = (self.limit + 255) // 256
+        return mix_in_length(merkleize_chunks(pack_bytes(bytes(out)), limit_chunks), n)
+
+
+# ------------------------------------------------------- sequence plumbing
+
+
+def _encode_sequence(types: list[SszType], values: list) -> bytes:
+    """Spec serialization: fixed parts (or offsets) then variable parts."""
+    fixed_parts = []
+    var_parts = []
+    for t, v in zip(types, values):
+        if t.is_fixed:
+            fixed_parts.append(t.encode(v))
+            var_parts.append(b"")
+        else:
+            fixed_parts.append(None)
+            var_parts.append(t.encode(v))
+    fixed_len_total = sum(
+        len(p) if p is not None else OFFSET_LEN for p in fixed_parts
+    )
+    out = bytearray()
+    var_offset = fixed_len_total
+    for p, vp in zip(fixed_parts, var_parts):
+        if p is None:
+            out += var_offset.to_bytes(OFFSET_LEN, "little")
+            var_offset += len(vp)
+        else:
+            out += p
+    for vp in var_parts:
+        out += vp
+    return bytes(out)
+
+
+def _decode_homogeneous(elem: SszType, data: bytes) -> list:
+    if elem.is_fixed:
+        n = elem.fixed_len
+        if n == 0 or len(data) % n:
+            raise SszError("bad fixed-sequence length")
+        return [elem.decode(data[i : i + n]) for i in range(0, len(data), n)]
+    if not data:
+        return []
+    first = int.from_bytes(data[:OFFSET_LEN], "little")
+    if first == 0 or first % OFFSET_LEN or first > len(data):
+        raise SszError("bad first offset")
+    count = first // OFFSET_LEN
+    offsets = [
+        int.from_bytes(data[i * 4 : i * 4 + 4], "little") for i in range(count)
+    ] + [len(data)]
+    out = []
+    for i in range(count):
+        a, b = offsets[i], offsets[i + 1]
+        if a > b or b > len(data):
+            raise SszError("offsets not monotonic")
+        out.append(elem.decode(data[a:b]))
+    return out
+
+
+# ---------------------------------------------------------------- containers
+
+
+class _ContainerSchema(SszType):
+    """Schema wrapper so a Container *class* can appear in fields tables."""
+
+    def __init__(self, cls):
+        self.cls = cls
+        types = list(cls.fields.values())
+        self.is_fixed = all(t.is_fixed for t in types)
+        self.fixed_len = (
+            sum(t.fixed_len for t in types) if self.is_fixed else 0
+        )
+
+    def default(self):
+        return self.cls()
+
+    def encode(self, v) -> bytes:
+        return v.encode()
+
+    def decode(self, data: bytes):
+        return self.cls.decode(data)
+
+    def hash_tree_root(self, v) -> bytes:
+        return v.hash_tree_root()
+
+
+class Container:
+    """Declarative SSZ container: subclasses set ``fields`` (name -> schema).
+
+    Usage mirrors the reference's ``#[derive(Encode, Decode, TreeHash)]``
+    structs (e.g. consensus/types/src/attestation.rs): declare fields once,
+    get serialization, deserialization, hashing and equality for free.
+    """
+
+    fields: dict[str, SszType] = {}
+
+    def __init__(self, **kwargs):
+        for name, t in self.fields.items():
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            else:
+                setattr(self, name, t.default())
+        if kwargs:
+            raise TypeError(f"unknown fields {sorted(kwargs)}")
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls.schema = _ContainerSchema(cls)
+
+    # -- SSZ -----------------------------------------------------------------
+    def encode(self) -> bytes:
+        types = list(self.fields.values())
+        values = [getattr(self, n) for n in self.fields]
+        return _encode_sequence(types, values)
+
+    @classmethod
+    def decode(cls, data: bytes):
+        names = list(cls.fields)
+        types = list(cls.fields.values())
+        fixed_total = sum(
+            t.fixed_len if t.is_fixed else OFFSET_LEN for t in types
+        )
+        if len(data) < fixed_total:
+            raise SszError(f"{cls.__name__}: truncated")
+        pos = 0
+        raw: list = []
+        offsets: list[tuple[int, int]] = []  # (field index, offset)
+        for i, t in enumerate(types):
+            if t.is_fixed:
+                raw.append(data[pos : pos + t.fixed_len])
+                pos += t.fixed_len
+            else:
+                off = int.from_bytes(data[pos : pos + OFFSET_LEN], "little")
+                offsets.append((i, off))
+                raw.append(None)
+                pos += OFFSET_LEN
+        if offsets:
+            if offsets[0][1] != fixed_total:
+                raise SszError(f"{cls.__name__}: bad first offset")
+            bounds = [o for _, o in offsets] + [len(data)]
+            for j, (i, off) in enumerate(offsets):
+                if bounds[j + 1] < off or off > len(data):
+                    raise SszError(f"{cls.__name__}: offsets not monotonic")
+                raw[i] = data[off : bounds[j + 1]]
+        elif pos != len(data):
+            raise SszError(f"{cls.__name__}: trailing bytes")
+        values = {n: t.decode(r) for n, t, r in zip(names, types, raw)}
+        return cls(**values)
+
+    def hash_tree_root(self) -> bytes:
+        chunks = [
+            t.hash_tree_root(getattr(self, n)) for n, t in self.fields.items()
+        ]
+        return merkleize_chunks(chunks)
+
+    # -- ergonomics ----------------------------------------------------------
+    def copy(self):
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and all(
+                getattr(self, n) == getattr(other, n) for n in self.fields
+            )
+        )
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self.fields)
+        return f"{type(self).__name__}({inner})"
+
+
+def container_schema(cls) -> _ContainerSchema:
+    """Schema descriptor for a Container subclass (for fields tables)."""
+    return cls.schema

@@ -1,0 +1,451 @@
+"""Consensus containers, fork-aware, preset-parameterized.
+
+Capability mirror of the reference's consensus/types crate (13.2k LoC of
+superstruct-generic containers, consensus/types/src/*.rs). Where Rust uses
+`superstruct` enums over forks and typenum presets, this module builds one
+namespace of container classes *per (preset, fork usage)* via
+``spec_types(preset)`` — fields whose lengths depend on the preset are
+instantiated from the ``Preset`` dataclass, and fork-variant containers
+(BeaconBlockBody / BeaconState) are separate classes with a shared prefix,
+plus helpers to upgrade between them.
+
+All containers are plain SSZ Containers (consensus/ssz.py): declaration is
+the schema; encode/decode/hash_tree_root/copy come free.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from types import SimpleNamespace
+
+from .config import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    Preset,
+)
+from .ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    uint8,
+    uint64,
+    uint256,
+)
+
+FORK_ORDER = ["phase0", "altair", "bellatrix"]
+
+
+# Preset-independent containers --------------------------------------------
+
+
+class Fork(Container):
+    """consensus/types/src/fork.rs"""
+
+    fields = {"previous_version": Bytes4, "current_version": Bytes4, "epoch": uint64}
+
+
+class ForkData(Container):
+    fields = {"current_version": Bytes4, "genesis_validators_root": Bytes32}
+
+
+class Checkpoint(Container):
+    """consensus/types/src/checkpoint.rs"""
+
+    fields = {"epoch": uint64, "root": Bytes32}
+
+
+class Validator(Container):
+    """consensus/types/src/validator.rs"""
+
+    fields = {
+        "pubkey": Bytes48,
+        "withdrawal_credentials": Bytes32,
+        "effective_balance": uint64,
+        "slashed": boolean,
+        "activation_eligibility_epoch": uint64,
+        "activation_epoch": uint64,
+        "exit_epoch": uint64,
+        "withdrawable_epoch": uint64,
+    }
+
+
+class AttestationData(Container):
+    """consensus/types/src/attestation_data.rs"""
+
+    fields = {
+        "slot": uint64,
+        "index": uint64,
+        "beacon_block_root": Bytes32,
+        "source": Checkpoint.schema,
+        "target": Checkpoint.schema,
+    }
+
+
+class Eth1Data(Container):
+    fields = {"deposit_root": Bytes32, "deposit_count": uint64, "block_hash": Bytes32}
+
+
+class BeaconBlockHeader(Container):
+    fields = {
+        "slot": uint64,
+        "proposer_index": uint64,
+        "parent_root": Bytes32,
+        "state_root": Bytes32,
+        "body_root": Bytes32,
+    }
+
+
+class SignedBeaconBlockHeader(Container):
+    fields = {"message": BeaconBlockHeader.schema, "signature": Bytes96}
+
+
+class ProposerSlashing(Container):
+    fields = {
+        "signed_header_1": SignedBeaconBlockHeader.schema,
+        "signed_header_2": SignedBeaconBlockHeader.schema,
+    }
+
+
+class DepositMessage(Container):
+    fields = {"pubkey": Bytes48, "withdrawal_credentials": Bytes32, "amount": uint64}
+
+
+class DepositData(Container):
+    fields = {
+        "pubkey": Bytes48,
+        "withdrawal_credentials": Bytes32,
+        "amount": uint64,
+        "signature": Bytes96,
+    }
+
+
+class Deposit(Container):
+    fields = {
+        "proof": Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1),
+        "data": DepositData.schema,
+    }
+
+
+class VoluntaryExit(Container):
+    fields = {"epoch": uint64, "validator_index": uint64}
+
+
+class SignedVoluntaryExit(Container):
+    fields = {"message": VoluntaryExit.schema, "signature": Bytes96}
+
+
+class SigningData(Container):
+    fields = {"object_root": Bytes32, "domain": Bytes32}
+
+
+class Eth1Block(Container):
+    """Deposit-follower cache entry (reference: beacon_node/eth1 block cache)."""
+
+    fields = {"hash": Bytes32, "timestamp": uint64, "number": uint64}
+
+
+# ----------------------------------------------------- preset-parameterized
+
+
+@lru_cache(maxsize=None)
+def spec_types(preset: Preset) -> SimpleNamespace:
+    """All preset-dependent containers for ``preset``, as a namespace.
+
+    The analogue of instantiating the reference's generics at
+    E = MainnetEthSpec / MinimalEthSpec.
+    """
+    p = preset
+
+    class IndexedAttestation(Container):
+        fields = {
+            "attesting_indices": List(uint64, p.MAX_VALIDATORS_PER_COMMITTEE),
+            "data": AttestationData.schema,
+            "signature": Bytes96,
+        }
+
+    class Attestation(Container):
+        fields = {
+            "aggregation_bits": Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE),
+            "data": AttestationData.schema,
+            "signature": Bytes96,
+        }
+
+    class PendingAttestation(Container):
+        fields = {
+            "aggregation_bits": Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE),
+            "data": AttestationData.schema,
+            "inclusion_delay": uint64,
+            "proposer_index": uint64,
+        }
+
+    class AttesterSlashing(Container):
+        fields = {
+            "attestation_1": IndexedAttestation.schema,
+            "attestation_2": IndexedAttestation.schema,
+        }
+
+    class HistoricalBatch(Container):
+        fields = {
+            "block_roots": Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+            "state_roots": Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        }
+
+    class SyncCommittee(Container):
+        fields = {
+            "pubkeys": Vector(Bytes48, p.SYNC_COMMITTEE_SIZE),
+            "aggregate_pubkey": Bytes48,
+        }
+
+    class SyncAggregate(Container):
+        fields = {
+            "sync_committee_bits": Bitvector(p.SYNC_COMMITTEE_SIZE),
+            "sync_committee_signature": Bytes96,
+        }
+
+    class SyncCommitteeMessage(Container):
+        fields = {
+            "slot": uint64,
+            "beacon_block_root": Bytes32,
+            "validator_index": uint64,
+            "signature": Bytes96,
+        }
+
+    class SyncCommitteeContribution(Container):
+        fields = {
+            "slot": uint64,
+            "beacon_block_root": Bytes32,
+            "subcommittee_index": uint64,
+            "aggregation_bits": Bitvector(
+                p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+            ),
+            "signature": Bytes96,
+        }
+
+    class ContributionAndProof(Container):
+        fields = {
+            "aggregator_index": uint64,
+            "contribution": SyncCommitteeContribution.schema,
+            "selection_proof": Bytes96,
+        }
+
+    class SignedContributionAndProof(Container):
+        fields = {"message": ContributionAndProof.schema, "signature": Bytes96}
+
+    class ExecutionPayload(Container):
+        fields = {
+            "parent_hash": Bytes32,
+            "fee_recipient": Bytes20,
+            "state_root": Bytes32,
+            "receipts_root": Bytes32,
+            "logs_bloom": ByteVector(p.BYTES_PER_LOGS_BLOOM),
+            "prev_randao": Bytes32,
+            "block_number": uint64,
+            "gas_limit": uint64,
+            "gas_used": uint64,
+            "timestamp": uint64,
+            "extra_data": ByteList(p.MAX_EXTRA_DATA_BYTES),
+            "base_fee_per_gas": uint256,
+            "block_hash": Bytes32,
+            "transactions": List(
+                ByteList(p.MAX_BYTES_PER_TRANSACTION), p.MAX_TRANSACTIONS_PER_PAYLOAD
+            ),
+        }
+
+    class ExecutionPayloadHeader(Container):
+        fields = {
+            **{
+                k: v
+                for k, v in ExecutionPayload.fields.items()
+                if k != "transactions"
+            },
+            "transactions_root": Bytes32,
+        }
+
+    # -- block bodies per fork ----------------------------------------------
+    _body_base = {
+        "randao_reveal": Bytes96,
+        "eth1_data": Eth1Data.schema,
+        "graffiti": Bytes32,
+        "proposer_slashings": List(ProposerSlashing.schema, p.MAX_PROPOSER_SLASHINGS),
+        "attester_slashings": List(AttesterSlashing.schema, p.MAX_ATTESTER_SLASHINGS),
+        "attestations": List(Attestation.schema, p.MAX_ATTESTATIONS),
+        "deposits": List(Deposit.schema, p.MAX_DEPOSITS),
+        "voluntary_exits": List(SignedVoluntaryExit.schema, p.MAX_VOLUNTARY_EXITS),
+    }
+
+    class BeaconBlockBodyPhase0(Container):
+        fields = dict(_body_base)
+
+    class BeaconBlockBodyAltair(Container):
+        fields = {**_body_base, "sync_aggregate": SyncAggregate.schema}
+
+    class BeaconBlockBodyBellatrix(Container):
+        fields = {
+            **_body_base,
+            "sync_aggregate": SyncAggregate.schema,
+            "execution_payload": ExecutionPayload.schema,
+        }
+
+    BODY_BY_FORK = {
+        "phase0": BeaconBlockBodyPhase0,
+        "altair": BeaconBlockBodyAltair,
+        "bellatrix": BeaconBlockBodyBellatrix,
+    }
+
+    def _block_cls(body_cls, fork_name):
+        class BeaconBlock(Container):
+            fields = {
+                "slot": uint64,
+                "proposer_index": uint64,
+                "parent_root": Bytes32,
+                "state_root": Bytes32,
+                "body": body_cls.schema,
+            }
+
+            fork = fork_name
+
+        BeaconBlock.__name__ = f"BeaconBlock{fork_name.capitalize()}"
+        return BeaconBlock
+
+    BLOCK_BY_FORK = {f: _block_cls(BODY_BY_FORK[f], f) for f in FORK_ORDER}
+
+    def _signed_block_cls(block_cls, fork_name):
+        class SignedBeaconBlock(Container):
+            fields = {"message": block_cls.schema, "signature": Bytes96}
+
+            fork = fork_name
+
+        SignedBeaconBlock.__name__ = f"SignedBeaconBlock{fork_name.capitalize()}"
+        return SignedBeaconBlock
+
+    SIGNED_BLOCK_BY_FORK = {
+        f: _signed_block_cls(BLOCK_BY_FORK[f], f) for f in FORK_ORDER
+    }
+
+    # -- states per fork -----------------------------------------------------
+    _state_prefix = {
+        "genesis_time": uint64,
+        "genesis_validators_root": Bytes32,
+        "slot": uint64,
+        "fork": Fork.schema,
+        "latest_block_header": BeaconBlockHeader.schema,
+        "block_roots": Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        "state_roots": Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        "historical_roots": List(Bytes32, p.HISTORICAL_ROOTS_LIMIT),
+        "eth1_data": Eth1Data.schema,
+        "eth1_data_votes": List(
+            Eth1Data.schema, p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH
+        ),
+        "eth1_deposit_index": uint64,
+        "validators": List(Validator.schema, p.VALIDATOR_REGISTRY_LIMIT),
+        "balances": List(uint64, p.VALIDATOR_REGISTRY_LIMIT),
+        "randao_mixes": Vector(Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR),
+        "slashings": Vector(uint64, p.EPOCHS_PER_SLASHINGS_VECTOR),
+    }
+    _state_suffix = {
+        "justification_bits": Bitvector(JUSTIFICATION_BITS_LENGTH),
+        "previous_justified_checkpoint": Checkpoint.schema,
+        "current_justified_checkpoint": Checkpoint.schema,
+        "finalized_checkpoint": Checkpoint.schema,
+    }
+
+    class BeaconStatePhase0(Container):
+        fields = {
+            **_state_prefix,
+            "previous_epoch_attestations": List(
+                PendingAttestation.schema, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH
+            ),
+            "current_epoch_attestations": List(
+                PendingAttestation.schema, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH
+            ),
+            **_state_suffix,
+        }
+
+        fork_name = "phase0"
+
+    _altair_fields = {
+        **_state_prefix,
+        "previous_epoch_participation": List(uint8, p.VALIDATOR_REGISTRY_LIMIT),
+        "current_epoch_participation": List(uint8, p.VALIDATOR_REGISTRY_LIMIT),
+        **_state_suffix,
+        "inactivity_scores": List(uint64, p.VALIDATOR_REGISTRY_LIMIT),
+        "current_sync_committee": SyncCommittee.schema,
+        "next_sync_committee": SyncCommittee.schema,
+    }
+
+    class BeaconStateAltair(Container):
+        fields = dict(_altair_fields)
+
+        fork_name = "altair"
+
+    class BeaconStateBellatrix(Container):
+        fields = {
+            **_altair_fields,
+            "latest_execution_payload_header": ExecutionPayloadHeader.schema,
+        }
+
+        fork_name = "bellatrix"
+
+    STATE_BY_FORK = {
+        "phase0": BeaconStatePhase0,
+        "altair": BeaconStateAltair,
+        "bellatrix": BeaconStateBellatrix,
+    }
+
+    class AggregateAndProof(Container):
+        fields = {
+            "aggregator_index": uint64,
+            "aggregate": Attestation.schema,
+            "selection_proof": Bytes96,
+        }
+
+    class SignedAggregateAndProof(Container):
+        fields = {"message": AggregateAndProof.schema, "signature": Bytes96}
+
+    return SimpleNamespace(
+        preset=p,
+        IndexedAttestation=IndexedAttestation,
+        Attestation=Attestation,
+        PendingAttestation=PendingAttestation,
+        AttesterSlashing=AttesterSlashing,
+        HistoricalBatch=HistoricalBatch,
+        SyncCommittee=SyncCommittee,
+        SyncAggregate=SyncAggregate,
+        SyncCommitteeMessage=SyncCommitteeMessage,
+        SyncCommitteeContribution=SyncCommitteeContribution,
+        ContributionAndProof=ContributionAndProof,
+        SignedContributionAndProof=SignedContributionAndProof,
+        ExecutionPayload=ExecutionPayload,
+        ExecutionPayloadHeader=ExecutionPayloadHeader,
+        BeaconBlockBodyPhase0=BeaconBlockBodyPhase0,
+        BeaconBlockBodyAltair=BeaconBlockBodyAltair,
+        BeaconBlockBodyBellatrix=BeaconBlockBodyBellatrix,
+        BODY_BY_FORK=BODY_BY_FORK,
+        BLOCK_BY_FORK=BLOCK_BY_FORK,
+        SIGNED_BLOCK_BY_FORK=SIGNED_BLOCK_BY_FORK,
+        BeaconStatePhase0=BeaconStatePhase0,
+        BeaconStateAltair=BeaconStateAltair,
+        BeaconStateBellatrix=BeaconStateBellatrix,
+        STATE_BY_FORK=STATE_BY_FORK,
+        AggregateAndProof=AggregateAndProof,
+        SignedAggregateAndProof=SignedAggregateAndProof,
+    )
+
+
+def state_fork_name(state) -> str:
+    return type(state).fork_name
+
+
+def block_fork_name(block) -> str:
+    return type(block).fork

@@ -1,0 +1,162 @@
+"""SSZ serialization + merkleization tests.
+
+Roots are cross-checked against *independent* hashlib computations in the
+test (not the module's own merkle core), and serializations against
+hand-assembled byte strings following the SSZ spec rules.
+"""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.consensus.hashing import ZERO_HASHES
+from lighthouse_tpu.consensus.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    SszError,
+    Vector,
+    boolean,
+    merkleize_chunks,
+    uint8,
+    uint16,
+    uint64,
+)
+
+
+def h(a, b):
+    return hashlib.sha256(a + b).digest()
+
+
+def test_uint_roundtrip_and_root():
+    assert uint64.encode(0x0123456789ABCDEF) == bytes.fromhex("efcdab8967452301")
+    assert uint64.decode(uint64.encode(12345)) == 12345
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+    assert uint16.encode(0x0102) == b"\x02\x01"
+    with pytest.raises(SszError):
+        uint8.decode(b"\x01\x02")
+
+
+def test_boolean():
+    assert boolean.encode(True) == b"\x01"
+    assert boolean.decode(b"\x00") is False
+    with pytest.raises(SszError):
+        boolean.decode(b"\x02")
+
+
+def test_bytes32_root_is_identity():
+    v = bytes(range(32))
+    assert Bytes32.hash_tree_root(v) == v
+    # 48 bytes -> two chunks -> one hash
+    v48 = bytes(range(48))
+    expect = h(v48[:32], v48[32:] + b"\x00" * 16)
+    assert Bytes48.hash_tree_root(v48) == expect
+
+
+def test_vector_of_uints_packs():
+    t = Vector(uint64, 8)  # 64 bytes -> 2 chunks
+    v = list(range(8))
+    packed = b"".join(x.to_bytes(8, "little") for x in v)
+    assert t.encode(v) == packed
+    assert t.hash_tree_root(v) == h(packed[:32], packed[32:])
+    assert t.decode(packed) == v
+
+
+def test_list_mixes_in_length():
+    t = List(uint64, 8)  # limit 8 uint64 = 64 bytes = 2 chunks
+    v = [1, 2, 3]
+    packed = b"".join(x.to_bytes(8, "little") for x in v)
+    chunk0 = packed.ljust(32, b"\x00")
+    root = h(h(chunk0, b"\x00" * 32), (3).to_bytes(32, "little"))
+    assert t.hash_tree_root(v) == root
+    assert t.decode(t.encode(v)) == v
+    # empty list: full-depth zero tree mixed with 0
+    assert t.hash_tree_root([]) == h(ZERO_HASHES[1], (0).to_bytes(32, "little"))
+
+
+def test_bitvector():
+    t = Bitvector(10)
+    v = [True, False] * 5
+    enc = t.encode(v)
+    assert len(enc) == 2
+    assert t.decode(enc) == v
+    with pytest.raises(SszError):
+        t.decode(b"\xff\xff")  # bits 10..15 set
+
+
+def test_bitlist_delimiter():
+    t = Bitlist(16)
+    v = [True, True, False, True]
+    enc = t.encode(v)
+    # bits 1101 -> 0b1011, delimiter at bit 4 -> 0b1_1011 = 0x1b
+    assert enc == b"\x1b"
+    assert t.decode(enc) == v
+    assert t.encode([]) == b"\x01"
+    assert t.decode(b"\x01") == []
+    with pytest.raises(SszError):
+        t.decode(b"\x00")
+
+
+def test_variable_list_of_bytelists():
+    t = List(ByteList(64), 4)
+    v = [b"ab", b"", b"cdef"]
+    enc = t.encode(v)
+    # 3 offsets (12 bytes) then payloads
+    assert enc[:4] == (12).to_bytes(4, "little")
+    assert enc[4:8] == (14).to_bytes(4, "little")
+    assert enc[8:12] == (14).to_bytes(4, "little")
+    assert enc[12:] == b"abcdef"
+    assert t.decode(enc) == v
+
+
+class Inner(Container):
+    fields = {"a": uint64, "b": Bytes32}
+
+
+class Outer(Container):
+    fields = {
+        "x": uint64,
+        "inner": Inner.schema,
+        "items": List(uint64, 4),
+    }
+
+
+def test_container_roundtrip():
+    o = Outer(x=7, inner=Inner(a=1, b=b"\x22" * 32), items=[5, 6])
+    enc = o.encode()
+    # fixed: 8 (x) + 40 (inner) + 4 (offset) = 52; items at offset 52
+    assert enc[48:52] == (52).to_bytes(4, "little")
+    back = Outer.decode(enc)
+    assert back == o
+
+    # root: merkleize [htr(x), htr(inner), htr(items)]
+    inner_root = h((1).to_bytes(8, "little") + b"\x00" * 24, b"\x22" * 32)
+    items_packed = (5).to_bytes(8, "little") + (6).to_bytes(8, "little")
+    items_root = h(items_packed.ljust(32, b"\x00"), (2).to_bytes(32, "little"))
+    expect = h(
+        h((7).to_bytes(8, "little") + b"\x00" * 24, inner_root),
+        h(items_root, b"\x00" * 32),
+    )
+    assert o.hash_tree_root() == expect
+
+
+def test_container_default_and_errors():
+    o = Outer()
+    assert o.x == 0 and o.items == [] and o.inner == Inner()
+    with pytest.raises(TypeError):
+        Outer(nope=1)
+    with pytest.raises(SszError):
+        Outer.decode(b"\x00" * 10)  # truncated
+
+
+def test_merkleize_limits():
+    c = [b"\x01" * 32]
+    assert merkleize_chunks(c) == c[0]
+    assert merkleize_chunks(c, 4) == h(h(c[0], b"\x00" * 32), ZERO_HASHES[1])
+    assert merkleize_chunks([], 1) == b"\x00" * 32
+    with pytest.raises(SszError):
+        merkleize_chunks(c * 3, 2)
