@@ -1,0 +1,77 @@
+"""Epoch committee cache.
+
+Capability mirror of the reference's CommitteeCache (consensus/types/src/
+beacon_state/committee_cache.rs:36 ``initialized``): one full-epoch
+swap-or-not shuffle computed once (vectorized, shuffle.py), then every
+(slot, committee_index) lookup is a slice. The reference keeps three of
+these in the BeaconState struct; here they live in a host-side dict keyed
+by (shuffling root, epoch) owned by whoever holds the state (the oracle
+transition keeps one per relative epoch; the chain keeps an LRU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .config import ChainSpec
+from .helpers import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_active_validator_indices,
+    get_committee_count_per_slot,
+    get_seed,
+)
+from .shuffle import shuffle_indices
+
+
+class CommitteeCache:
+    def __init__(
+        self,
+        epoch: int,
+        shuffling: np.ndarray,
+        committees_per_slot: int,
+        slots_per_epoch: int,
+    ):
+        self.epoch = epoch
+        self.shuffling = shuffling  # active indices, shuffled
+        self.committees_per_slot = committees_per_slot
+        self.slots_per_epoch = slots_per_epoch
+
+    @classmethod
+    def initialized(cls, state, epoch: int, spec: ChainSpec) -> "CommitteeCache":
+        active = get_active_validator_indices(state, epoch)
+        seed = get_seed(state, epoch, spec.DOMAIN_BEACON_ATTESTER, spec)
+        perm = shuffle_indices(
+            len(active), seed, spec.preset.SHUFFLE_ROUND_COUNT
+        )
+        shuffling = active[perm] if len(active) else active
+        return cls(
+            epoch,
+            shuffling,
+            get_committee_count_per_slot(state, epoch, spec),
+            spec.preset.SLOTS_PER_EPOCH,
+        )
+
+    @property
+    def committee_count(self) -> int:
+        return self.committees_per_slot * self.slots_per_epoch
+
+    def get_beacon_committee(self, slot: int, index: int) -> np.ndarray:
+        if index >= self.committees_per_slot:
+            raise ValueError("committee index out of range")
+        if slot // self.slots_per_epoch != self.epoch:
+            raise ValueError("slot not in cached epoch")
+        global_index = (
+            slot % self.slots_per_epoch
+        ) * self.committees_per_slot + index
+        n = len(self.shuffling)
+        total = self.committee_count
+        start = n * global_index // total
+        end = n * (global_index + 1) // total
+        return self.shuffling[start:end]
+
+    def committees_at_slot(self, slot: int) -> list[np.ndarray]:
+        return [
+            self.get_beacon_committee(slot, i)
+            for i in range(self.committees_per_slot)
+        ]

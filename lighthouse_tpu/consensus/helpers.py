@@ -1,0 +1,331 @@
+"""Beacon-chain helper functions: epoch/slot math, predicates, accessors,
+and registry mutators.
+
+Capability mirror of the reference's accessor layer spread across
+consensus/types/src/beacon_state.rs (get_* methods, committee/proposer
+seeds) and consensus/state_processing (common/*.rs: initiate_validator_exit,
+slash_validator, get_attesting_indices, ...). Functions take (state, spec)
+explicitly — states are plain SSZ containers; caches live outside the state
+(see committee_cache.py) mirroring how the reference keeps them in
+non-hashed fields.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .config import (
+    ChainSpec,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    TIMELY_TARGET_FLAG_INDEX,
+)
+from .hashing import hash_bytes
+from .shuffle import compute_shuffled_index, shuffle_indices
+from .types import state_fork_name
+
+DOMAIN_LEN = 4
+
+
+# ------------------------------------------------------------ slot/epoch math
+
+
+def compute_epoch_at_slot(slot: int, spec: ChainSpec) -> int:
+    return slot // spec.preset.SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int, spec: ChainSpec) -> int:
+    return epoch * spec.preset.SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int, spec: ChainSpec) -> int:
+    return epoch + 1 + spec.preset.MAX_SEED_LOOKAHEAD
+
+
+def get_current_epoch(state, spec: ChainSpec) -> int:
+    return compute_epoch_at_slot(state.slot, spec)
+
+
+def get_previous_epoch(state, spec: ChainSpec) -> int:
+    cur = get_current_epoch(state, spec)
+    return cur - 1 if cur > GENESIS_EPOCH else GENESIS_EPOCH
+
+
+def get_randao_mix(state, epoch: int, spec: ChainSpec) -> bytes:
+    return state.randao_mixes[epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_block_root_at_slot(state, slot: int, spec: ChainSpec) -> bytes:
+    if not (slot < state.slot <= slot + spec.preset.SLOTS_PER_HISTORICAL_ROOT):
+        raise ValueError("slot out of block-roots range")
+    return state.block_roots[slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(state, epoch: int, spec: ChainSpec) -> bytes:
+    return get_block_root_at_slot(
+        state, compute_start_slot_at_epoch(epoch, spec), spec
+    )
+
+
+# ----------------------------------------------------------------- predicates
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def is_eligible_for_activation_queue(v, spec: ChainSpec) -> bool:
+    return (
+        v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        and v.effective_balance == spec.preset.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def is_eligible_for_activation(state, v) -> bool:
+    return (
+        v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+        and v.activation_epoch == FAR_FUTURE_EPOCH
+    )
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed) and (
+        v.activation_epoch <= epoch < v.withdrawable_epoch
+    )
+
+
+def is_slashable_attestation_data(data_1, data_2) -> bool:
+    """Double vote or surround vote (spec is_slashable_attestation_data)."""
+    double = (
+        data_1 != data_2
+        and data_1.target.epoch == data_2.target.epoch
+    )
+    surround = (
+        data_1.source.epoch < data_2.source.epoch
+        and data_2.target.epoch < data_1.target.epoch
+    )
+    return double or surround
+
+
+# ------------------------------------------------------------------ accessors
+
+
+def get_active_validator_indices(state, epoch: int) -> np.ndarray:
+    return np.asarray(
+        [
+            i
+            for i, v in enumerate(state.validators)
+            if is_active_validator(v, epoch)
+        ],
+        dtype=np.int64,
+    )
+
+
+def get_validator_churn_limit(state, spec: ChainSpec) -> int:
+    active = len(
+        get_active_validator_indices(state, get_current_epoch(state, spec))
+    )
+    return max(
+        spec.MIN_PER_EPOCH_CHURN_LIMIT, active // spec.CHURN_LIMIT_QUOTIENT
+    )
+
+
+def get_seed(state, epoch: int, domain_type: bytes, spec: ChainSpec) -> bytes:
+    mix = get_randao_mix(
+        state,
+        epoch
+        + spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
+        - spec.preset.MIN_SEED_LOOKAHEAD
+        - 1,
+        spec,
+    )
+    return hash_bytes(domain_type + epoch.to_bytes(8, "little") + mix)
+
+
+def get_committee_count_per_slot(state, epoch: int, spec: ChainSpec) -> int:
+    p = spec.preset
+    active = len(get_active_validator_indices(state, epoch))
+    return max(
+        1,
+        min(
+            p.MAX_COMMITTEES_PER_SLOT,
+            active // p.SLOTS_PER_EPOCH // p.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+def get_beacon_committee(
+    state, slot: int, index: int, spec: ChainSpec, cache=None
+) -> np.ndarray:
+    """Spec get_beacon_committee; pass a CommitteeCache to amortize the
+    epoch shuffle (committee_cache.py)."""
+    if cache is not None:
+        return cache.get_beacon_committee(slot, index)
+    from .committee_cache import CommitteeCache
+
+    epoch = compute_epoch_at_slot(slot, spec)
+    return CommitteeCache.initialized(state, epoch, spec).get_beacon_committee(
+        slot, index
+    )
+
+
+def get_total_balance(state, indices, spec: ChainSpec) -> int:
+    total = sum(int(state.validators[int(i)].effective_balance) for i in indices)
+    return max(spec.preset.EFFECTIVE_BALANCE_INCREMENT, total)
+
+
+def get_total_active_balance(state, spec: ChainSpec) -> int:
+    return get_total_balance(
+        state,
+        get_active_validator_indices(state, get_current_epoch(state, spec)),
+        spec,
+    )
+
+
+def compute_proposer_index(
+    state, indices: np.ndarray, seed: bytes, spec: ChainSpec
+) -> int:
+    """Spec compute_proposer_index: shuffled candidate walk with
+    effective-balance rejection sampling."""
+    if len(indices) == 0:
+        raise ValueError("no active validators")
+    MAX_RANDOM_BYTE = 2**8 - 1
+    total = len(indices)
+    i = 0
+    while True:
+        cand = int(
+            indices[
+                compute_shuffled_index(
+                    i % total, total, seed, spec.preset.SHUFFLE_ROUND_COUNT
+                )
+            ]
+        )
+        random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eb = state.validators[cand].effective_balance
+        if eb * MAX_RANDOM_BYTE >= spec.preset.MAX_EFFECTIVE_BALANCE * random_byte:
+            return cand
+        i += 1
+
+
+def get_beacon_proposer_index(state, spec: ChainSpec) -> int:
+    epoch = get_current_epoch(state, spec)
+    seed = hash_bytes(
+        get_seed(state, epoch, spec.DOMAIN_BEACON_PROPOSER, spec)
+        + state.slot.to_bytes(8, "little")
+    )
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed, spec)
+
+
+def get_attesting_indices(
+    state, data, aggregation_bits, spec: ChainSpec, cache=None
+) -> list[int]:
+    """Spec get_attesting_indices: committee members whose bit is set."""
+    committee = get_beacon_committee(state, data.slot, data.index, spec, cache)
+    if len(aggregation_bits) != len(committee):
+        raise ValueError("aggregation bitfield length mismatch")
+    return [int(v) for v, bit in zip(committee, aggregation_bits) if bit]
+
+
+def get_indexed_attestation(state, attestation, spec: ChainSpec, cache=None):
+    from .config import PRESETS
+    from .types import spec_types
+
+    t = spec_types(spec.preset)
+    indices = sorted(
+        get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits, spec, cache
+        )
+    )
+    return t.IndexedAttestation(
+        attesting_indices=indices,
+        data=attestation.data,
+        signature=attestation.signature,
+    )
+
+
+def is_valid_indexed_attestation_structure(indexed, spec: ChainSpec) -> bool:
+    """Structural half of spec is_valid_indexed_attestation (signature
+    verification is the backend's job)."""
+    idx = indexed.attesting_indices
+    return len(idx) > 0 and list(idx) == sorted(set(idx))
+
+
+# ------------------------------------------------------------------- mutators
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+def initiate_validator_exit(state, index: int, spec: ChainSpec) -> None:
+    """Spec initiate_validator_exit (reference:
+    state_processing/src/common/initiate_validator_exit.rs)."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        w.exit_epoch
+        for w in state.validators
+        if w.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(get_current_epoch(state, spec), spec)]
+    )
+    exit_queue_churn = sum(
+        1 for w in state.validators if w.exit_epoch == exit_queue_epoch
+    )
+    if exit_queue_churn >= get_validator_churn_limit(state, spec):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + spec.preset.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+def slash_validator(
+    state, slashed_index: int, spec: ChainSpec, whistleblower_index: int | None = None
+) -> None:
+    """Spec slash_validator, fork-aware penalty quotients (reference:
+    state_processing/src/common/slash_validator.rs)."""
+    p = spec.preset
+    fork = state_fork_name(state)
+    epoch = get_current_epoch(state, spec)
+    initiate_validator_exit(state, slashed_index, spec)
+    v = state.validators[slashed_index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + p.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % p.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+
+    if fork == "phase0":
+        min_quot = p.MIN_SLASHING_PENALTY_QUOTIENT
+        proposer_weight_num, proposer_weight_den = 0, 1  # whole reward to proposer
+    elif fork == "altair":
+        min_quot = p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+        proposer_weight_num, proposer_weight_den = 8, 64  # PROPOSER_WEIGHT/WEIGHT_DENOMINATOR
+    else:
+        min_quot = p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+        proposer_weight_num, proposer_weight_den = 8, 64
+    decrease_balance(state, slashed_index, v.effective_balance // min_quot)
+
+    proposer_index = get_beacon_proposer_index(state, spec)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = v.effective_balance // p.WHISTLEBLOWER_REWARD_QUOTIENT
+    if fork == "phase0":
+        proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    else:
+        proposer_reward = (
+            whistleblower_reward * proposer_weight_num // proposer_weight_den
+        )
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(
+        state, whistleblower_index, whistleblower_reward - proposer_reward
+    )

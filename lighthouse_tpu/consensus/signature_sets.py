@@ -1,0 +1,264 @@
+"""Builders turning spec objects into SignatureSets.
+
+Capability mirror of the reference's signature_sets.rs (consensus/
+state_processing/src/per_block_processing/signature_sets.rs:74-563) — the
+complete vocabulary of everything the chain ever verifies. Every builder
+takes a ``get_pubkey: Callable[[int], PublicKey | None]`` decompressed-key
+provider (the ValidatorPubkeyCache seam) and returns a
+crypto.bls.api.SignatureSet whose message is a 32-byte signing root; all
+sets funnel to ``verify_signature_sets`` on whichever backend is selected
+(the TPU path being the point of this framework).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..crypto.bls.api import AggregateSignature, PublicKey, Signature, SignatureSet
+from .config import ChainSpec, compute_signing_root
+from .hashing import hash32_concat
+from .helpers import (
+    compute_epoch_at_slot,
+    get_block_root_at_slot,
+)
+from .ssz import merkleize_chunks, uint64
+from .types import DepositMessage, SigningData
+
+GetPubkey = Callable[[int], Optional[PublicKey]]
+
+
+class SignatureSetError(ValueError):
+    """A pubkey was unknown or a signature was undecodable."""
+
+
+def _pk(get_pubkey: GetPubkey, index: int) -> PublicKey:
+    pk = get_pubkey(int(index))
+    if pk is None:
+        raise SignatureSetError(f"unknown validator index {index}")
+    return pk
+
+
+def _sig(raw: bytes) -> AggregateSignature:
+    try:
+        return AggregateSignature.from_bytes(bytes(raw))
+    except ValueError as e:
+        raise SignatureSetError(str(e)) from None
+
+
+def signing_root_of(obj, domain: bytes) -> bytes:
+    return compute_signing_root(obj, domain)
+
+
+def signing_root_of_root(root: bytes, domain: bytes) -> bytes:
+    """compute_signing_root for something whose hash_tree_root is known."""
+    return merkleize_chunks([root, domain])
+
+
+def signing_root_of_epoch(epoch: int, domain: bytes) -> bytes:
+    return signing_root_of_root(uint64.hash_tree_root(epoch), domain)
+
+
+# ------------------------------------------------------------------ builders
+# Each mirrors the same-named fn in signature_sets.rs (line refs in parens).
+
+
+def block_proposal_signature_set(
+    state, get_pubkey: GetPubkey, signed_block, spec: ChainSpec,
+    block_root: bytes | None = None,
+) -> SignatureSet:
+    """(:74) Proposal signature over the block's signing root."""
+    block = signed_block.message
+    epoch = compute_epoch_at_slot(block.slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_BEACON_PROPOSER, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    if block_root is None:
+        message = signing_root_of(block, domain)
+    else:
+        message = signing_root_of_root(block_root, domain)
+    return SignatureSet.multiple_pubkeys(
+        _sig(signed_block.signature),
+        [_pk(get_pubkey, block.proposer_index)],
+        message,
+    )
+
+
+def randao_signature_set(
+    state, get_pubkey: GetPubkey, block, spec: ChainSpec
+) -> SignatureSet:
+    """(:155) RANDAO reveal: BLS over the epoch number."""
+    epoch = compute_epoch_at_slot(block.slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_RANDAO, epoch, state.fork, state.genesis_validators_root
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(block.body.randao_reveal),
+        [_pk(get_pubkey, block.proposer_index)],
+        signing_root_of_epoch(epoch, domain),
+    )
+
+
+def proposer_slashing_signature_sets(
+    state, get_pubkey: GetPubkey, slashing, spec: ChainSpec
+) -> list[SignatureSet]:
+    """(:187) Both headers of a proposer slashing."""
+    out = []
+    for signed_header in (slashing.signed_header_1, slashing.signed_header_2):
+        header = signed_header.message
+        epoch = compute_epoch_at_slot(header.slot, spec)
+        domain = spec.get_domain(
+            spec.DOMAIN_BEACON_PROPOSER, epoch, state.fork,
+            state.genesis_validators_root,
+        )
+        out.append(
+            SignatureSet.multiple_pubkeys(
+                _sig(signed_header.signature),
+                [_pk(get_pubkey, header.proposer_index)],
+                signing_root_of(header, domain),
+            )
+        )
+    return out
+
+
+def indexed_attestation_signature_set(
+    state, get_pubkey: GetPubkey, signature: bytes, indexed, spec: ChainSpec
+) -> SignatureSet:
+    """(:235) Aggregate attestation signature over AttestationData."""
+    domain = spec.get_domain(
+        spec.DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    pubkeys = [_pk(get_pubkey, i) for i in indexed.attesting_indices]
+    return SignatureSet.multiple_pubkeys(
+        _sig(signature), pubkeys, signing_root_of(indexed.data, domain)
+    )
+
+
+def attester_slashing_signature_sets(
+    state, get_pubkey: GetPubkey, slashing, spec: ChainSpec
+) -> list[SignatureSet]:
+    """(:299) Both indexed attestations of an attester slashing."""
+    return [
+        indexed_attestation_signature_set(
+            state, get_pubkey, att.signature, att, spec
+        )
+        for att in (slashing.attestation_1, slashing.attestation_2)
+    ]
+
+
+def deposit_pubkey_signature_message(
+    deposit_data, spec: ChainSpec
+) -> tuple[PublicKey, AggregateSignature, bytes] | None:
+    """(:328) Deposit self-signature: fixed genesis-fork domain, pubkey from
+    the deposit itself; returns None if the pubkey is undecodable (deposits
+    may legally carry garbage)."""
+    try:
+        pk = PublicKey.from_bytes(bytes(deposit_data.pubkey))
+        sig = AggregateSignature.from_bytes(bytes(deposit_data.signature))
+    except ValueError:
+        return None
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    msg = DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    return pk, sig, signing_root_of(msg, domain)
+
+
+def exit_signature_set(
+    state, get_pubkey: GetPubkey, signed_exit, spec: ChainSpec
+) -> SignatureSet:
+    """(:341) Voluntary exit over the exit message."""
+    exit_msg = signed_exit.message
+    domain = spec.get_domain(
+        spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(signed_exit.signature),
+        [_pk(get_pubkey, exit_msg.validator_index)],
+        signing_root_of(exit_msg, domain),
+    )
+
+
+def signed_aggregate_selection_proof_signature_set(
+    state, get_pubkey: GetPubkey, signed_aggregate, spec: ChainSpec
+) -> SignatureSet:
+    """(:370) Aggregator's slot-selection proof."""
+    message = signed_aggregate.message
+    slot = message.aggregate.data.slot
+    epoch = compute_epoch_at_slot(slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_SELECTION_PROOF, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(message.selection_proof),
+        [_pk(get_pubkey, message.aggregator_index)],
+        signing_root_of_root(uint64.hash_tree_root(slot), domain),
+    )
+
+
+def signed_aggregate_signature_set(
+    state, get_pubkey: GetPubkey, signed_aggregate, spec: ChainSpec
+) -> SignatureSet:
+    """(:400) Outer signature of a SignedAggregateAndProof."""
+    message = signed_aggregate.message
+    epoch = compute_epoch_at_slot(message.aggregate.data.slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(signed_aggregate.signature),
+        [_pk(get_pubkey, message.aggregator_index)],
+        signing_root_of(message, domain),
+    )
+
+
+def sync_aggregate_signature_set(
+    state, get_pubkey: GetPubkey, sync_aggregate, slot: int,
+    block_root: bytes | None, spec: ChainSpec, participant_indices=None,
+) -> SignatureSet | None:
+    """(:533) Sync-committee aggregate for the block at ``slot``.
+
+    ``participant_indices``: validator indices of the set bits (the caller
+    resolves the current sync committee). None result = empty participation
+    with infinity signature (valid by spec, nothing to verify).
+    """
+    bits = sync_aggregate.sync_committee_bits
+    if participant_indices is None:
+        raise SignatureSetError("participant indices required")
+    previous_slot = max(slot, 1) - 1
+    if block_root is None:
+        block_root = get_block_root_at_slot(state, previous_slot, spec)
+    epoch = compute_epoch_at_slot(previous_slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_SYNC_COMMITTEE, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    sig = _sig(sync_aggregate.sync_committee_signature)
+    pubkeys = [_pk(get_pubkey, i) for i in participant_indices]
+    if not pubkeys and sig.is_infinity():
+        return None  # spec: empty participation + infinity sig is valid
+    return SignatureSet.multiple_pubkeys(
+        sig, pubkeys, signing_root_of_root(block_root, domain)
+    )
+
+
+def sync_committee_message_set(
+    state, get_pubkey: GetPubkey, message, spec: ChainSpec
+) -> SignatureSet:
+    """(:435) A single validator's sync-committee message."""
+    epoch = compute_epoch_at_slot(message.slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_SYNC_COMMITTEE, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(message.signature),
+        [_pk(get_pubkey, message.validator_index)],
+        signing_root_of_root(bytes(message.beacon_block_root), domain),
+    )

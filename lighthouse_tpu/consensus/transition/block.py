@@ -1,0 +1,668 @@
+"""per_block_processing — the spec block state-transition, fork-aware.
+
+Capability mirror of the reference's per_block_processing.rs:90 and its
+submodules (process_operations, verify_*, altair sync-aggregate, bellatrix
+execution-payload glue) plus block_signature_verifier.rs:66: signature
+handling follows the same three strategies {VerifyIndividually, VerifyBulk,
+NoVerification}; under BULK every signature set in the block (proposal,
+randao, slashings, attestations, exits, sync aggregate — NOT deposits,
+which may legally be invalid) is collected and shipped to
+``verify_signature_sets`` as ONE batch — on the TPU backend that is one
+fused multi-pairing, the reason this framework exists.
+
+State is mutated in place; callers copy first (the reference takes &mut).
+Raises BlockProcessingError on any invalid condition.
+"""
+
+from __future__ import annotations
+
+import math
+from enum import Enum
+
+from ...crypto.bls.api import verify_signature_sets
+from ..config import (
+    ChainSpec,
+    FAR_FUTURE_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+from ..hashing import hash_bytes, hash32_concat
+from .. import helpers as h
+from .. import signature_sets as sigs
+from ..committee_cache import CommitteeCache
+from ..types import (
+    BeaconBlockHeader,
+    Validator,
+    block_fork_name,
+    spec_types,
+    state_fork_name,
+)
+
+
+class BlockProcessingError(ValueError):
+    pass
+
+
+class SignatureStrategy(Enum):
+    """reference: BlockSignatureStrategy (per_block_processing.rs)."""
+
+    VERIFY_INDIVIDUALLY = "individually"
+    VERIFY_BULK = "bulk"
+    NO_VERIFICATION = "none"
+
+
+def _err(cond: bool, msg: str) -> None:
+    if not cond:
+        raise BlockProcessingError(msg)
+
+
+class _SigCollector:
+    """Collects signature sets (BULK), verifies each eagerly (INDIVIDUAL),
+    or ignores them (NONE) — reference: BlockSignatureVerifier."""
+
+    def __init__(self, strategy: SignatureStrategy, backend: str | None):
+        self.strategy = strategy
+        self.backend = backend
+        self.sets = []
+
+    def add(self, sig_set) -> None:
+        if sig_set is None or self.strategy is SignatureStrategy.NO_VERIFICATION:
+            return
+        if self.strategy is SignatureStrategy.VERIFY_INDIVIDUALLY:
+            _err(
+                verify_signature_sets([sig_set], backend=self.backend),
+                "signature verification failed",
+            )
+        else:
+            self.sets.append(sig_set)
+
+    def finish(self) -> None:
+        if self.strategy is SignatureStrategy.VERIFY_BULK and self.sets:
+            _err(
+                verify_signature_sets(self.sets, backend=self.backend),
+                "bulk signature verification failed",
+            )
+
+
+# ------------------------------------------------------------ entry point
+
+
+def per_block_processing(
+    state,
+    signed_block,
+    spec: ChainSpec,
+    *,
+    strategy: SignatureStrategy = SignatureStrategy.VERIFY_BULK,
+    get_pubkey: sigs.GetPubkey | None = None,
+    backend: str | None = None,
+    verify_block_root: bool | None = None,
+    caches: dict | None = None,
+) -> None:
+    """Apply ``signed_block`` to ``state`` (already advanced to block.slot).
+
+    ``caches``: optional {epoch: CommitteeCache} dict, filled on demand.
+    """
+    block = signed_block.message
+    _err(
+        block_fork_name(block) == state_fork_name(state),
+        "block/state fork mismatch",
+    )
+    if get_pubkey is None:
+        get_pubkey = _registry_pubkey_provider(state)
+    col = _SigCollector(strategy, backend)
+    caches = caches if caches is not None else {}
+
+    col.add(
+        sigs.block_proposal_signature_set(state, get_pubkey, signed_block, spec)
+    )
+    process_block_header(state, block, spec)
+    if state_fork_name(state) == "bellatrix":
+        process_execution_payload(state, block.body.execution_payload, spec)
+    process_randao(state, block, spec, col, get_pubkey)
+    process_eth1_data(state, block.body.eth1_data, spec)
+    process_operations(state, block.body, spec, col, get_pubkey, caches)
+    if state_fork_name(state) in ("altair", "bellatrix"):
+        process_sync_aggregate(
+            state, block.body.sync_aggregate, spec, col, get_pubkey
+        )
+    col.finish()
+
+
+def _registry_pubkey_provider(state):
+    """Decompress pubkeys straight from the registry (slow path; the chain
+    layer supplies a ValidatorPubkeyCache-backed provider instead)."""
+    from ...crypto.bls.api import PublicKey
+
+    memo: dict[int, object] = {}
+
+    def get(i: int):
+        if i in memo:
+            return memo[i]
+        if i >= len(state.validators):
+            return None
+        try:
+            pk = PublicKey.from_bytes(bytes(state.validators[i].pubkey))
+        except ValueError:
+            return None
+        memo[i] = pk
+        return pk
+
+    return get
+
+
+# ------------------------------------------------------------------- header
+
+
+def process_block_header(state, block, spec: ChainSpec) -> None:
+    _err(block.slot == state.slot, "block slot != state slot")
+    _err(
+        block.slot > state.latest_block_header.slot,
+        "block not newer than latest header",
+    )
+    _err(
+        block.proposer_index == h.get_beacon_proposer_index(state, spec),
+        "wrong proposer index",
+    )
+    _err(
+        bytes(block.parent_root)
+        == state.latest_block_header.hash_tree_root(),
+        "parent root mismatch",
+    )
+    state.latest_block_header = BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,
+        body_root=block.body.hash_tree_root(),
+    )
+    proposer = state.validators[block.proposer_index]
+    _err(not proposer.slashed, "proposer is slashed")
+
+
+# ------------------------------------------------------------------- randao
+
+
+def process_randao(state, block, spec, col, get_pubkey) -> None:
+    epoch = h.get_current_epoch(state, spec)
+    col.add(sigs.randao_signature_set(state, get_pubkey, block, spec))
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            h.get_randao_mix(state, epoch, spec),
+            hash_bytes(bytes(block.body.randao_reveal)),
+        )
+    )
+    state.randao_mixes[
+        epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
+    ] = mix
+
+
+# ---------------------------------------------------------------- eth1 data
+
+
+def process_eth1_data(state, eth1_data, spec: ChainSpec) -> None:
+    state.eth1_data_votes.append(eth1_data)
+    period_slots = (
+        spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.preset.SLOTS_PER_EPOCH
+    )
+    if (
+        sum(1 for v in state.eth1_data_votes if v == eth1_data) * 2
+        > period_slots
+    ):
+        state.eth1_data = eth1_data
+
+
+# --------------------------------------------------------------- operations
+
+
+def process_operations(state, body, spec, col, get_pubkey, caches) -> None:
+    expected_deposits = min(
+        spec.preset.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    _err(
+        len(body.deposits) == expected_deposits,
+        "wrong deposit count in block",
+    )
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(state, ps, spec, col, get_pubkey)
+    for ats in body.attester_slashings:
+        process_attester_slashing(state, ats, spec, col, get_pubkey)
+    for att in body.attestations:
+        process_attestation(state, att, spec, col, get_pubkey, caches)
+    for dep in body.deposits:
+        process_deposit(state, dep, spec)
+    for exit_ in body.voluntary_exits:
+        process_voluntary_exit(state, exit_, spec, col, get_pubkey)
+
+
+def process_proposer_slashing(state, slashing, spec, col, get_pubkey) -> None:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _err(h1.slot == h2.slot, "proposer slashing: slot mismatch")
+    _err(
+        h1.proposer_index == h2.proposer_index,
+        "proposer slashing: proposer mismatch",
+    )
+    _err(h1 != h2, "proposer slashing: identical headers")
+    _err(
+        h1.proposer_index < len(state.validators),
+        "proposer slashing: unknown validator",
+    )
+    proposer = state.validators[h1.proposer_index]
+    _err(
+        h.is_slashable_validator(proposer, h.get_current_epoch(state, spec)),
+        "proposer slashing: not slashable",
+    )
+    for s in sigs.proposer_slashing_signature_sets(
+        state, get_pubkey, slashing, spec
+    ):
+        col.add(s)
+    h.slash_validator(state, h1.proposer_index, spec)
+
+
+def process_attester_slashing(state, slashing, spec, col, get_pubkey) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _err(
+        h.is_slashable_attestation_data(a1.data, a2.data),
+        "attester slashing: not slashable data",
+    )
+    for att in (a1, a2):
+        _err(
+            h.is_valid_indexed_attestation_structure(att, spec),
+            "attester slashing: malformed indexed attestation",
+        )
+    for s in sigs.attester_slashing_signature_sets(
+        state, get_pubkey, slashing, spec
+    ):
+        col.add(s)
+    epoch = h.get_current_epoch(state, spec)
+    slashed_any = False
+    common = set(a1.attesting_indices) & set(a2.attesting_indices)
+    for index in sorted(common):
+        if h.is_slashable_validator(state.validators[index], epoch):
+            h.slash_validator(state, index, spec)
+            slashed_any = True
+    _err(slashed_any, "attester slashing: no one slashed")
+
+
+def _committee_cache(state, epoch, spec, caches) -> CommitteeCache:
+    if epoch not in caches:
+        caches[epoch] = CommitteeCache.initialized(state, epoch, spec)
+    return caches[epoch]
+
+
+def _validate_attestation_common(state, att, spec, caches):
+    data = att.data
+    current = h.get_current_epoch(state, spec)
+    previous = h.get_previous_epoch(state, spec)
+    _err(
+        data.target.epoch in (previous, current),
+        "attestation: target epoch out of range",
+    )
+    _err(
+        data.target.epoch == h.compute_epoch_at_slot(data.slot, spec),
+        "attestation: target/slot mismatch",
+    )
+    _err(
+        data.slot + spec.preset.MIN_ATTESTATION_INCLUSION_DELAY
+        <= state.slot
+        <= data.slot + spec.preset.SLOTS_PER_EPOCH,
+        "attestation: inclusion window",
+    )
+    cache = _committee_cache(state, data.target.epoch, spec, caches)
+    _err(
+        data.index < cache.committees_per_slot,
+        "attestation: committee index out of range",
+    )
+    committee = cache.get_beacon_committee(data.slot, data.index)
+    _err(
+        len(att.aggregation_bits) == len(committee),
+        "attestation: bitfield length mismatch",
+    )
+    return committee
+
+
+def process_attestation(state, att, spec, col, get_pubkey, caches) -> None:
+    committee = _validate_attestation_common(state, att, spec, caches)
+    data = att.data
+    cache = caches[data.target.epoch]
+    indexed = h.get_indexed_attestation(state, att, spec, cache)
+    _err(
+        h.is_valid_indexed_attestation_structure(indexed, spec),
+        "attestation: malformed indexed attestation",
+    )
+    col.add(
+        sigs.indexed_attestation_signature_set(
+            state, get_pubkey, att.signature, indexed, spec
+        )
+    )
+
+    if state_fork_name(state) == "phase0":
+        _process_attestation_phase0(state, att, spec)
+    else:
+        _process_attestation_altair(state, att, indexed, spec)
+
+
+def _process_attestation_phase0(state, att, spec) -> None:
+    t = spec_types(spec.preset)
+    data = att.data
+    current = h.get_current_epoch(state, spec)
+    pending = t.PendingAttestation(
+        aggregation_bits=att.aggregation_bits,
+        data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=h.get_beacon_proposer_index(state, spec),
+    )
+    if data.target.epoch == current:
+        _err(
+            data.source == state.current_justified_checkpoint,
+            "attestation: wrong source (current)",
+        )
+        state.current_epoch_attestations.append(pending)
+    else:
+        _err(
+            data.source == state.previous_justified_checkpoint,
+            "attestation: wrong source (previous)",
+        )
+        state.previous_epoch_attestations.append(pending)
+
+
+# -- altair participation-flag accounting -----------------------------------
+
+
+def has_flag(flags: int, index: int) -> bool:
+    return bool((flags >> index) & 1)
+
+
+def add_flag(flags: int, index: int) -> int:
+    return flags | (1 << index)
+
+
+def get_base_reward_per_increment(state, spec) -> int:
+    return (
+        spec.preset.EFFECTIVE_BALANCE_INCREMENT
+        * spec.preset.BASE_REWARD_FACTOR
+        // math.isqrt(h.get_total_active_balance(state, spec))
+    )
+
+
+def get_base_reward_altair(state, index: int, spec) -> int:
+    increments = (
+        state.validators[index].effective_balance
+        // spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    )
+    return increments * get_base_reward_per_increment(state, spec)
+
+
+def get_attestation_participation_flag_indices(
+    state, data, inclusion_delay: int, spec
+) -> list[int]:
+    """Spec (altair): which timeliness flags an attestation earns."""
+    current = h.get_current_epoch(state, spec)
+    if data.target.epoch == current:
+        justified = state.current_justified_checkpoint
+    else:
+        justified = state.previous_justified_checkpoint
+    is_matching_source = data.source == justified
+    _err(is_matching_source, "attestation: source mismatch")
+    is_matching_target = is_matching_source and bytes(data.target.root) == bytes(
+        h.get_block_root(state, data.target.epoch, spec)
+    )
+    is_matching_head = is_matching_target and bytes(
+        data.beacon_block_root
+    ) == bytes(h.get_block_root_at_slot(state, data.slot, spec))
+
+    flags = []
+    if is_matching_source and inclusion_delay <= math.isqrt(
+        spec.preset.SLOTS_PER_EPOCH
+    ):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= spec.preset.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if (
+        is_matching_head
+        and inclusion_delay == spec.preset.MIN_ATTESTATION_INCLUSION_DELAY
+    ):
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def _process_attestation_altair(state, att, indexed, spec) -> None:
+    data = att.data
+    inclusion_delay = state.slot - data.slot
+    flag_indices = get_attestation_participation_flag_indices(
+        state, data, inclusion_delay, spec
+    )
+    if data.target.epoch == h.get_current_epoch(state, spec):
+        participation = state.current_epoch_participation
+    else:
+        participation = state.previous_epoch_participation
+
+    proposer_reward_numerator = 0
+    for index in indexed.attesting_indices:
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if flag_index in flag_indices and not has_flag(
+                participation[index], flag_index
+            ):
+                participation[index] = add_flag(participation[index], flag_index)
+                proposer_reward_numerator += (
+                    get_base_reward_altair(state, index, spec) * weight
+                )
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    h.increase_balance(
+        state,
+        h.get_beacon_proposer_index(state, spec),
+        proposer_reward_numerator // proposer_reward_denominator,
+    )
+
+
+# ----------------------------------------------------------------- deposits
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch, depth: int, index: int, root: bytes
+) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hash32_concat(bytes(branch[i]), value)
+        else:
+            value = hash32_concat(value, bytes(branch[i]))
+    return value == bytes(root)
+
+
+def process_deposit(state, deposit, spec: ChainSpec) -> None:
+    _err(
+        is_valid_merkle_branch(
+            deposit.data.hash_tree_root(),
+            deposit.proof,
+            32 + 1,  # DEPOSIT_CONTRACT_TREE_DEPTH + 1 (length mix-in)
+            state.eth1_deposit_index,
+            state.eth1_data.deposit_root,
+        ),
+        "deposit: bad merkle proof",
+    )
+    state.eth1_deposit_index += 1
+    apply_deposit(state, deposit.data, spec)
+
+
+def apply_deposit(state, data, spec: ChainSpec, *, require_proof: bool = True) -> None:
+    pubkey = bytes(data.pubkey)
+    amount = data.amount
+    registry_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    if pubkey not in registry_pubkeys:
+        # New validator: its deposit signature must be self-consistent;
+        # invalid ones are silently ignored (reference: deposits may fail
+        # signature checks without invalidating the block).
+        check = sigs.deposit_pubkey_signature_message(data, spec)
+        if check is None:
+            return
+        pk, sig, message = check
+        if not sig.to_signature().verify(pk, message):
+            return
+        state.validators.append(
+            Validator(
+                pubkey=data.pubkey,
+                withdrawal_credentials=data.withdrawal_credentials,
+                effective_balance=min(
+                    amount - amount % spec.preset.EFFECTIVE_BALANCE_INCREMENT,
+                    spec.preset.MAX_EFFECTIVE_BALANCE,
+                ),
+                slashed=False,
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+                activation_epoch=FAR_FUTURE_EPOCH,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(amount)
+        if state_fork_name(state) in ("altair", "bellatrix"):
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+            state.inactivity_scores.append(0)
+    else:
+        index = registry_pubkeys.index(pubkey)
+        h.increase_balance(state, index, amount)
+
+
+# -------------------------------------------------------------------- exits
+
+
+def process_voluntary_exit(state, signed_exit, spec, col, get_pubkey) -> None:
+    exit_msg = signed_exit.message
+    current = h.get_current_epoch(state, spec)
+    _err(
+        exit_msg.validator_index < len(state.validators),
+        "exit: unknown validator",
+    )
+    v = state.validators[exit_msg.validator_index]
+    _err(h.is_active_validator(v, current), "exit: not active")
+    _err(v.exit_epoch == FAR_FUTURE_EPOCH, "exit: already exiting")
+    _err(current >= exit_msg.epoch, "exit: not yet valid")
+    _err(
+        current >= v.activation_epoch + spec.preset.SHARD_COMMITTEE_PERIOD,
+        "exit: too young",
+    )
+    col.add(sigs.exit_signature_set(state, get_pubkey, signed_exit, spec))
+    h.initiate_validator_exit(state, exit_msg.validator_index, spec)
+
+
+# ----------------------------------------------------------- sync aggregate
+
+
+def process_sync_aggregate(state, sync_aggregate, spec, col, get_pubkey) -> None:
+    # Map committee pubkeys -> validator indices (the chain layer caches
+    # this; registry scan here mirrors the spec's eth1-style lookup).
+    pubkey_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    committee_indices = [
+        pubkey_to_index[bytes(pk)]
+        for pk in state.current_sync_committee.pubkeys
+    ]
+    participants = [
+        idx
+        for idx, bit in zip(
+            committee_indices, sync_aggregate.sync_committee_bits
+        )
+        if bit
+    ]
+    col.add(
+        sigs.sync_aggregate_signature_set(
+            state,
+            get_pubkey,
+            sync_aggregate,
+            state.slot,
+            None,
+            spec,
+            participant_indices=participants,
+        )
+    )
+
+    # Rewards.
+    p = spec.preset
+    total_active_increments = (
+        h.get_total_active_balance(state, spec) // p.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        get_base_reward_per_increment(state, spec) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // p.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // p.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward
+        * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    proposer_index = h.get_beacon_proposer_index(state, spec)
+    for idx, bit in zip(committee_indices, sync_aggregate.sync_committee_bits):
+        if bit:
+            h.increase_balance(state, idx, participant_reward)
+            h.increase_balance(state, proposer_index, proposer_reward)
+        else:
+            h.decrease_balance(state, idx, participant_reward)
+
+
+# -------------------------------------------------------- execution payload
+
+
+def is_merge_transition_complete(state, spec) -> bool:
+    t = spec_types(spec.preset)
+    return state.latest_execution_payload_header != t.ExecutionPayloadHeader()
+
+
+def compute_timestamp_at_slot(state, slot: int, spec) -> int:
+    return state.genesis_time + (slot - 0) * spec.SECONDS_PER_SLOT
+
+
+def process_execution_payload(
+    state, payload, spec: ChainSpec, notify_new_payload=None
+) -> None:
+    """Spec (bellatrix) process_execution_payload. ``notify_new_payload`` is
+    the execution-engine hook (reference: execution_layer notify_new_payload);
+    None = accept (the mock/optimistic path)."""
+    t = spec_types(spec.preset)
+    if is_merge_transition_complete(state, spec):
+        _err(
+            bytes(payload.parent_hash)
+            == bytes(state.latest_execution_payload_header.block_hash),
+            "payload: parent hash mismatch",
+        )
+    _err(
+        bytes(payload.prev_randao)
+        == bytes(
+            h.get_randao_mix(state, h.get_current_epoch(state, spec), spec)
+        ),
+        "payload: prev_randao mismatch",
+    )
+    _err(
+        payload.timestamp == compute_timestamp_at_slot(state, state.slot, spec),
+        "payload: bad timestamp",
+    )
+    if notify_new_payload is not None:
+        _err(notify_new_payload(payload), "payload: rejected by engine")
+
+    from ..ssz import ByteList, List as SszList
+
+    tx_schema = t.ExecutionPayload.fields["transactions"]
+    state.latest_execution_payload_header = t.ExecutionPayloadHeader(
+        **{
+            k: getattr(payload, k)
+            for k in t.ExecutionPayloadHeader.fields
+            if k != "transactions_root"
+        },
+        transactions_root=tx_schema.hash_tree_root(payload.transactions),
+    )

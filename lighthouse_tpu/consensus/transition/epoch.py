@@ -1,0 +1,551 @@
+"""process_epoch — the spec epoch transition, fork-aware.
+
+Capability mirror of the reference's per_epoch_processing.rs:27 with its
+base/ (phase0 ValidatorStatuses walk) and altair/ (ParticipationCache over
+epoch participation flags) variants: justification & finalization, rewards
+& penalties, inactivity updates, registry updates, slashings, and the
+end-of-epoch resets (eth1 votes, effective balances, slashings vector,
+randao mixes, historical roots, participation records, sync committees).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ...crypto.bls.api import PublicKey, aggregate_pubkeys
+from ..config import (
+    ChainSpec,
+    GENESIS_EPOCH,
+    JUSTIFICATION_BITS_LENGTH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+from ..hashing import hash_bytes
+from .. import helpers as h
+from ..shuffle import compute_shuffled_index
+from ..types import Checkpoint, spec_types, state_fork_name
+from .block import get_base_reward_per_increment, has_flag
+
+BASE_REWARDS_PER_EPOCH = 4  # phase0
+
+
+def process_epoch(state, spec: ChainSpec) -> None:
+    fork = state_fork_name(state)
+    if fork == "phase0":
+        process_justification_and_finalization_phase0(state, spec)
+        process_rewards_and_penalties_phase0(state, spec)
+    else:
+        process_justification_and_finalization_altair(state, spec)
+        process_inactivity_updates(state, spec)
+        process_rewards_and_penalties_altair(state, spec)
+    process_registry_updates(state, spec)
+    process_slashings(state, spec)
+    process_eth1_data_reset(state, spec)
+    process_effective_balance_updates(state, spec)
+    process_slashings_reset(state, spec)
+    process_randao_mixes_reset(state, spec)
+    process_historical_roots_update(state, spec)
+    if fork == "phase0":
+        process_participation_record_updates(state)
+    else:
+        process_participation_flag_updates(state)
+        process_sync_committee_updates(state, spec)
+
+
+# ------------------------------------------------------------ shared helpers
+
+
+def get_finality_delay(state, spec) -> int:
+    return h.get_previous_epoch(state, spec) - state.finalized_checkpoint.epoch
+
+
+def is_in_inactivity_leak(state, spec) -> bool:
+    return get_finality_delay(state, spec) > spec.preset.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def get_eligible_validator_indices(state, spec) -> list[int]:
+    prev = h.get_previous_epoch(state, spec)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if h.is_active_validator(v, prev)
+        or (v.slashed and prev + 1 < v.withdrawable_epoch)
+    ]
+
+
+# --------------------------------------------------- phase0: pending-att path
+
+
+def get_matching_source_attestations(state, epoch: int, spec):
+    if epoch == h.get_current_epoch(state, spec):
+        return state.current_epoch_attestations
+    if epoch == h.get_previous_epoch(state, spec):
+        return state.previous_epoch_attestations
+    raise ValueError("epoch out of range")
+
+
+def get_matching_target_attestations(state, epoch: int, spec):
+    root = h.get_block_root(state, epoch, spec)
+    return [
+        a
+        for a in get_matching_source_attestations(state, epoch, spec)
+        if bytes(a.data.target.root) == bytes(root)
+    ]
+
+
+def get_matching_head_attestations(state, epoch: int, spec):
+    return [
+        a
+        for a in get_matching_target_attestations(state, epoch, spec)
+        if bytes(a.data.beacon_block_root)
+        == bytes(h.get_block_root_at_slot(state, a.data.slot, spec))
+    ]
+
+
+def get_unslashed_attesting_indices(state, attestations, spec, caches=None) -> set[int]:
+    caches = caches if caches is not None else {}
+    out: set[int] = set()
+    for a in attestations:
+        out |= set(
+            h.get_attesting_indices(
+                state, a.data, a.aggregation_bits, spec,
+                _cache_for(state, a.data.target.epoch, spec, caches),
+            )
+        )
+    return {i for i in out if not state.validators[i].slashed}
+
+
+def _cache_for(state, epoch, spec, caches):
+    from ..committee_cache import CommitteeCache
+
+    if epoch not in caches:
+        caches[epoch] = CommitteeCache.initialized(state, epoch, spec)
+    return caches[epoch]
+
+
+def get_attesting_balance(state, attestations, spec, caches=None) -> int:
+    return h.get_total_balance(
+        state, get_unslashed_attesting_indices(state, attestations, spec, caches), spec
+    )
+
+
+def process_justification_and_finalization_phase0(state, spec) -> None:
+    if h.get_current_epoch(state, spec) <= GENESIS_EPOCH + 1:
+        return
+    caches: dict = {}
+    prev = h.get_previous_epoch(state, spec)
+    cur = h.get_current_epoch(state, spec)
+    prev_target = get_attesting_balance(
+        state, get_matching_target_attestations(state, prev, spec), spec, caches
+    )
+    cur_target = get_attesting_balance(
+        state, get_matching_target_attestations(state, cur, spec), spec, caches
+    )
+    weigh_justification_and_finalization(
+        state, h.get_total_active_balance(state, spec), prev_target, cur_target, spec
+    )
+
+
+def weigh_justification_and_finalization(
+    state, total_balance: int, prev_target: int, cur_target: int, spec
+) -> None:
+    prev = h.get_previous_epoch(state, spec)
+    cur = h.get_current_epoch(state, spec)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = state.justification_bits
+    state.justification_bits = [False] + bits[: JUSTIFICATION_BITS_LENGTH - 1]
+    if prev_target * 3 >= total_balance * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=prev, root=h.get_block_root(state, prev, spec)
+        )
+        state.justification_bits[1] = True
+    if cur_target * 3 >= total_balance * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=cur, root=h.get_block_root(state, cur, spec)
+        )
+        state.justification_bits[0] = True
+
+    bits = state.justification_bits
+    if all(bits[1:4]) and old_prev_justified.epoch + 3 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[1:3]) and old_prev_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[0:3]) and old_cur_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_cur_justified
+    if all(bits[0:2]) and old_cur_justified.epoch + 1 == cur:
+        state.finalized_checkpoint = old_cur_justified
+
+
+def get_base_reward_phase0(state, index: int, total_balance: int, spec) -> int:
+    return (
+        state.validators[index].effective_balance
+        * spec.preset.BASE_REWARD_FACTOR
+        // math.isqrt(total_balance)
+        // BASE_REWARDS_PER_EPOCH
+    )
+
+
+def get_proposer_reward_phase0(state, index: int, total_balance: int, spec) -> int:
+    return (
+        get_base_reward_phase0(state, index, total_balance, spec)
+        // spec.preset.PROPOSER_REWARD_QUOTIENT
+    )
+
+
+def process_rewards_and_penalties_phase0(state, spec) -> None:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    caches: dict = {}
+    prev = h.get_previous_epoch(state, spec)
+    total_balance = h.get_total_active_balance(state, spec)
+    eligible = get_eligible_validator_indices(state, spec)
+    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    leak = is_in_inactivity_leak(state, spec)
+
+    source_atts = get_matching_source_attestations(state, prev, spec)
+    target_atts = get_matching_target_attestations(state, prev, spec)
+    head_atts = get_matching_head_attestations(state, prev, spec)
+
+    # Source / target / head component deltas.
+    for atts in (source_atts, target_atts, head_atts):
+        unslashed = get_unslashed_attesting_indices(state, atts, spec, caches)
+        attesting_balance = h.get_total_balance(state, unslashed, spec)
+        for index in eligible:
+            base = get_base_reward_phase0(state, index, total_balance, spec)
+            if index in unslashed:
+                if leak:
+                    rewards[index] += base
+                else:
+                    rewards[index] += (
+                        base
+                        * (attesting_balance // increment)
+                        // (total_balance // increment)
+                    )
+            else:
+                penalties[index] += base
+
+    # Proposer + inclusion-delay rewards.
+    source_unslashed = get_unslashed_attesting_indices(
+        state, source_atts, spec, caches
+    )
+    for index in source_unslashed:
+        candidates = [
+            a
+            for a in source_atts
+            if index
+            in h.get_attesting_indices(
+                state, a.data, a.aggregation_bits, spec,
+                _cache_for(state, a.data.target.epoch, spec, caches),
+            )
+        ]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        base = get_base_reward_phase0(state, index, total_balance, spec)
+        proposer_reward = base // spec.preset.PROPOSER_REWARD_QUOTIENT
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = base - proposer_reward
+        rewards[index] += max_attester_reward // attestation.inclusion_delay
+
+    # Inactivity penalties.
+    if leak:
+        target_unslashed = get_unslashed_attesting_indices(
+            state, target_atts, spec, caches
+        )
+        delay = get_finality_delay(state, spec)
+        for index in eligible:
+            base = get_base_reward_phase0(state, index, total_balance, spec)
+            penalties[index] += (
+                BASE_REWARDS_PER_EPOCH * base
+                - get_proposer_reward_phase0(state, index, total_balance, spec)
+            )
+            if index not in target_unslashed:
+                penalties[index] += (
+                    state.validators[index].effective_balance
+                    * delay
+                    // spec.preset.INACTIVITY_PENALTY_QUOTIENT
+                )
+
+    for i in range(n):
+        h.increase_balance(state, i, rewards[i])
+        h.decrease_balance(state, i, penalties[i])
+
+
+# ------------------------------------------------- altair: participation path
+
+
+def get_unslashed_participating_indices(
+    state, flag_index: int, epoch: int, spec
+) -> set[int]:
+    if epoch == h.get_current_epoch(state, spec):
+        participation = state.current_epoch_participation
+    elif epoch == h.get_previous_epoch(state, spec):
+        participation = state.previous_epoch_participation
+    else:
+        raise ValueError("epoch out of range")
+    return {
+        i
+        for i, v in enumerate(state.validators)
+        if h.is_active_validator(v, epoch)
+        and has_flag(participation[i], flag_index)
+        and not v.slashed
+    }
+
+
+def process_justification_and_finalization_altair(state, spec) -> None:
+    if h.get_current_epoch(state, spec) <= GENESIS_EPOCH + 1:
+        return
+    prev = h.get_previous_epoch(state, spec)
+    cur = h.get_current_epoch(state, spec)
+    prev_target = h.get_total_balance(
+        state,
+        get_unslashed_participating_indices(
+            state, TIMELY_TARGET_FLAG_INDEX, prev, spec
+        ),
+        spec,
+    )
+    cur_target = h.get_total_balance(
+        state,
+        get_unslashed_participating_indices(
+            state, TIMELY_TARGET_FLAG_INDEX, cur, spec
+        ),
+        spec,
+    )
+    weigh_justification_and_finalization(
+        state, h.get_total_active_balance(state, spec), prev_target, cur_target, spec
+    )
+
+
+def process_inactivity_updates(state, spec) -> None:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        return
+    prev = h.get_previous_epoch(state, spec)
+    target_participants = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, prev, spec
+    )
+    leak = is_in_inactivity_leak(state, spec)
+    for index in get_eligible_validator_indices(state, spec):
+        score = state.inactivity_scores[index]
+        if index in target_participants:
+            score -= min(1, score)
+        else:
+            score += spec.INACTIVITY_SCORE_BIAS
+        if not leak:
+            score -= min(spec.INACTIVITY_SCORE_RECOVERY_RATE, score)
+        state.inactivity_scores[index] = score
+
+
+def _base_reward_altair(state, index, spec, per_increment) -> int:
+    increments = (
+        state.validators[index].effective_balance
+        // spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    )
+    return increments * per_increment
+
+
+def process_rewards_and_penalties_altair(state, spec) -> None:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    prev = h.get_previous_epoch(state, spec)
+    total_balance = h.get_total_active_balance(state, spec)
+    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    active_increments = total_balance // increment
+    per_increment = get_base_reward_per_increment(state, spec)
+    eligible = get_eligible_validator_indices(state, spec)
+    leak = is_in_inactivity_leak(state, spec)
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        unslashed = get_unslashed_participating_indices(
+            state, flag_index, prev, spec
+        )
+        unslashed_balance = h.get_total_balance(state, unslashed, spec)
+        unslashed_increments = unslashed_balance // increment
+        for index in eligible:
+            base = _base_reward_altair(state, index, spec, per_increment)
+            if index in unslashed:
+                if not leak:
+                    numerator = base * weight * unslashed_increments
+                    rewards[index] += numerator // (
+                        active_increments * WEIGHT_DENOMINATOR
+                    )
+            elif flag_index != TIMELY_HEAD_FLAG_INDEX:
+                penalties[index] += base * weight // WEIGHT_DENOMINATOR
+
+    # Inactivity-score penalties.
+    if state_fork_name(state) == "bellatrix":
+        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    else:
+        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    target_participants = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, prev, spec
+    )
+    for index in eligible:
+        if index not in target_participants:
+            penalty_numerator = (
+                state.validators[index].effective_balance
+                * state.inactivity_scores[index]
+            )
+            penalties[index] += penalty_numerator // (
+                spec.INACTIVITY_SCORE_BIAS * quotient
+            )
+
+    for i in range(n):
+        h.increase_balance(state, i, rewards[i])
+        h.decrease_balance(state, i, penalties[i])
+
+
+# ------------------------------------------------------------ shared stages
+
+
+def process_registry_updates(state, spec) -> None:
+    current = h.get_current_epoch(state, spec)
+    for index, v in enumerate(state.validators):
+        if h.is_eligible_for_activation_queue(v, spec):
+            v.activation_eligibility_epoch = current + 1
+        if (
+            h.is_active_validator(v, current)
+            and v.effective_balance <= spec.EJECTION_BALANCE
+        ):
+            h.initiate_validator_exit(state, index, spec)
+
+    queue = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if h.is_eligible_for_activation(state, v)
+        ),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    for index in queue[: h.get_validator_churn_limit(state, spec)]:
+        state.validators[index].activation_epoch = (
+            h.compute_activation_exit_epoch(current, spec)
+        )
+
+
+def process_slashings(state, spec) -> None:
+    epoch = h.get_current_epoch(state, spec)
+    total_balance = h.get_total_active_balance(state, spec)
+    fork = state_fork_name(state)
+    p = spec.preset
+    mult = {
+        "phase0": p.PROPORTIONAL_SLASHING_MULTIPLIER,
+        "altair": p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR,
+        "bellatrix": p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+    }[fork]
+    adjusted = min(sum(state.slashings) * mult, total_balance)
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    for index, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch
+        ):
+            penalty_numerator = v.effective_balance // increment * adjusted
+            penalty = penalty_numerator // total_balance * increment
+            h.decrease_balance(state, index, penalty)
+
+
+def process_eth1_data_reset(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(state, spec) -> None:
+    p = spec.preset
+    hysteresis_increment = p.EFFECTIVE_BALANCE_INCREMENT // p.HYSTERESIS_QUOTIENT
+    down = hysteresis_increment * p.HYSTERESIS_DOWNWARD_MULTIPLIER
+    up = hysteresis_increment * p.HYSTERESIS_UPWARD_MULTIPLIER
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        if (
+            balance + down < v.effective_balance
+            or v.effective_balance + up < balance
+        ):
+            v.effective_balance = min(
+                balance - balance % p.EFFECTIVE_BALANCE_INCREMENT,
+                p.MAX_EFFECTIVE_BALANCE,
+            )
+
+
+def process_slashings_reset(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    state.slashings[next_epoch % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(state, spec) -> None:
+    current = h.get_current_epoch(state, spec)
+    next_epoch = current + 1
+    state.randao_mixes[
+        next_epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
+    ] = h.get_randao_mix(state, current, spec)
+
+
+def process_historical_roots_update(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    p = spec.preset
+    if next_epoch % (p.SLOTS_PER_HISTORICAL_ROOT // p.SLOTS_PER_EPOCH) == 0:
+        t = spec_types(p)
+        batch = t.HistoricalBatch(
+            block_roots=list(state.block_roots),
+            state_roots=list(state.state_roots),
+        )
+        state.historical_roots.append(batch.hash_tree_root())
+
+
+def process_participation_record_updates(state) -> None:
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+def process_participation_flag_updates(state) -> None:
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+# ------------------------------------------------------------ sync committee
+
+
+def get_next_sync_committee_indices(state, spec) -> list[int]:
+    p = spec.preset
+    epoch = h.get_current_epoch(state, spec) + 1
+    active = h.get_active_validator_indices(state, epoch)
+    count = len(active)
+    seed = h.get_seed(state, epoch, spec.DOMAIN_SYNC_COMMITTEE, spec)
+    indices: list[int] = []
+    i = 0
+    while len(indices) < p.SYNC_COMMITTEE_SIZE:
+        shuffled = compute_shuffled_index(
+            i % count, count, seed, p.SHUFFLE_ROUND_COUNT
+        )
+        candidate = int(active[shuffled])
+        random_byte = hash_bytes(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eb = state.validators[candidate].effective_balance
+        if eb * 255 >= p.MAX_EFFECTIVE_BALANCE * random_byte:
+            indices.append(candidate)
+        i += 1
+    return indices
+
+
+def get_next_sync_committee(state, spec):
+    t = spec_types(spec.preset)
+    indices = get_next_sync_committee_indices(state, spec)
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    agg = aggregate_pubkeys([PublicKey.from_bytes(pk) for pk in pubkeys])
+    return t.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=agg.to_bytes())
+
+
+def process_sync_committee_updates(state, spec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % spec.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state, spec)

@@ -1,0 +1,63 @@
+"""per_slot_processing + state advance + fork upgrades.
+
+Capability mirror of the reference's per_slot_processing.rs:25 (cache the
+state/block roots, trigger process_epoch on the boundary, apply scheduled
+fork upgrades) and state_advance.rs (complete/partial advance used by the
+chain's state-advance timer).
+"""
+
+from __future__ import annotations
+
+from ..config import ChainSpec
+from .. import helpers as h
+from ..types import state_fork_name
+from .epoch import get_next_sync_committee, process_epoch
+from .upgrade import upgrade_to_altair, upgrade_to_bellatrix
+
+
+class SlotProcessingError(ValueError):
+    pass
+
+
+def process_slots(state, target_slot: int, spec: ChainSpec):
+    """Advance ``state`` to ``target_slot`` (spec process_slots). Returns the
+    (possibly fork-upgraded) state — callers must use the return value."""
+    if target_slot < state.slot:
+        raise SlotProcessingError("cannot rewind state")
+    while state.slot < target_slot:
+        process_slot(state, spec)
+        if (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0:
+            process_epoch(state, spec)
+        state.slot += 1
+        state = _maybe_upgrade(state, spec)
+    return state
+
+
+def process_slot(state, spec: ChainSpec) -> None:
+    """Cache state/block roots for the current slot (spec process_slot)."""
+    p = spec.preset
+    previous_state_root = state.hash_tree_root()
+    state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
+        previous_state_root
+    )
+    if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+        state.latest_block_header.state_root = previous_state_root
+    previous_block_root = state.latest_block_header.hash_tree_root()
+    state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
+        previous_block_root
+    )
+
+
+def _maybe_upgrade(state, spec: ChainSpec):
+    """Apply a scheduled fork upgrade at the first slot of the fork epoch
+    (reference: per_slot_processing.rs fork-upgrade hook + upgrade/*.rs)."""
+    if state.slot % spec.preset.SLOTS_PER_EPOCH != 0:
+        return state
+    epoch = h.get_current_epoch(state, spec)
+    fork = state_fork_name(state)
+    if fork == "phase0" and spec.ALTAIR_FORK_EPOCH is not None and epoch == spec.ALTAIR_FORK_EPOCH:
+        state = upgrade_to_altair(state, spec)
+        fork = "altair"
+    if fork == "altair" and spec.BELLATRIX_FORK_EPOCH is not None and epoch == spec.BELLATRIX_FORK_EPOCH:
+        state = upgrade_to_bellatrix(state, spec)
+    return state

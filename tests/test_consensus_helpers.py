@@ -1,0 +1,133 @@
+"""Shuffle, helper, and committee-cache tests (host-only, fast)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus.committee_cache import CommitteeCache
+from lighthouse_tpu.consensus.config import (
+    FAR_FUTURE_EPOCH,
+    MINIMAL,
+    minimal_spec,
+)
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus.shuffle import (
+    compute_shuffled_index,
+    shuffle_indices,
+)
+from lighthouse_tpu.consensus.types import Checkpoint, Validator, spec_types
+
+
+def test_shuffle_vectorized_matches_scalar():
+    seed = b"\x5a" * 32
+    for n in (1, 2, 7, 64, 257):
+        vec = shuffle_indices(n, seed, 10)
+        assert sorted(vec.tolist()) == list(range(n))  # permutation
+        for i in range(0, n, max(1, n // 7)):
+            assert vec[i] == compute_shuffled_index(i, n, seed, 10)
+
+
+def test_shuffle_seed_sensitivity():
+    a = shuffle_indices(100, b"\x01" * 32, 10)
+    b = shuffle_indices(100, b"\x02" * 32, 10)
+    assert a.tolist() != b.tolist()
+
+
+def _make_state(n_validators=64, slot=0):
+    spec = minimal_spec()
+    t = spec_types(MINIMAL)
+    state = t.BeaconStatePhase0(slot=slot)
+    state.validators = [
+        Validator(
+            pubkey=bytes([i % 256]) * 48,
+            effective_balance=spec.preset.MAX_EFFECTIVE_BALANCE,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for i in range(n_validators)
+    ]
+    state.balances = [spec.preset.MAX_EFFECTIVE_BALANCE] * n_validators
+    state.randao_mixes = [
+        bytes([i % 256]) * 32
+        for i in range(spec.preset.EPOCHS_PER_HISTORICAL_VECTOR)
+    ]
+    return state, spec
+
+
+def test_active_indices_and_committees():
+    state, spec = _make_state(64)
+    active = h.get_active_validator_indices(state, 0)
+    assert len(active) == 64
+    cache = CommitteeCache.initialized(state, 0, spec)
+    # minimal: 64 active / 8 slots / target 4 -> 2 committees/slot
+    assert cache.committees_per_slot == 2
+    seen = []
+    for slot in range(8):
+        for idx in range(2):
+            seen += cache.get_beacon_committee(slot, idx).tolist()
+    assert sorted(seen) == list(range(64))  # every validator exactly once
+
+
+def test_proposer_index_deterministic_and_active():
+    state, spec = _make_state(64, slot=3)
+    p1 = h.get_beacon_proposer_index(state, spec)
+    p2 = h.get_beacon_proposer_index(state, spec)
+    assert p1 == p2
+    assert 0 <= p1 < 64
+
+
+def test_exit_queue_and_churn():
+    state, spec = _make_state(64, slot=0)
+    h.initiate_validator_exit(state, 0, spec)
+    first_exit = state.validators[0].exit_epoch
+    assert first_exit == h.compute_activation_exit_epoch(0, spec)
+    # churn limit (minimal: max(4, 64//32)=4): 4 exits share the epoch,
+    # the 5th spills to the next.
+    for i in range(1, 5):
+        h.initiate_validator_exit(state, i, spec)
+    assert state.validators[3].exit_epoch == first_exit
+    assert state.validators[4].exit_epoch == first_exit + 1
+    # idempotent
+    h.initiate_validator_exit(state, 0, spec)
+    assert state.validators[0].exit_epoch == first_exit
+
+
+def test_slash_validator_updates_balances():
+    state, spec = _make_state(64, slot=0)
+    before = state.balances[1]
+    h.slash_validator(state, 1, spec)
+    v = state.validators[1]
+    assert v.slashed
+    # max(exit-queue withdrawable, epoch + EPOCHS_PER_SLASHINGS_VECTOR)
+    assert v.withdrawable_epoch >= spec.preset.EPOCHS_PER_SLASHINGS_VECTOR
+    assert v.withdrawable_epoch != FAR_FUTURE_EPOCH
+    assert state.balances[1] < before
+    assert state.slashings[0] == v.effective_balance
+
+
+def test_slashable_attestation_data():
+    a = lambda s, t: type(
+        "D", (), {
+            "source": Checkpoint(epoch=s), "target": Checkpoint(epoch=t),
+            "__eq__": lambda self, o: (self.source, self.target) == (o.source, o.target),
+        },
+    )()
+    from lighthouse_tpu.consensus.types import AttestationData
+
+    d1 = AttestationData(source=Checkpoint(epoch=1), target=Checkpoint(epoch=5))
+    d2 = AttestationData(
+        source=Checkpoint(epoch=1), target=Checkpoint(epoch=5),
+        beacon_block_root=b"\x01" * 32,
+    )
+    assert h.is_slashable_attestation_data(d1, d2)  # double vote
+    d3 = AttestationData(source=Checkpoint(epoch=0), target=Checkpoint(epoch=6))
+    assert h.is_slashable_attestation_data(d3, d1)  # surround
+    assert not h.is_slashable_attestation_data(d1, d1)
+
+
+def test_block_roots_range():
+    state, spec = _make_state(8, slot=10)
+    state.block_roots = [bytes([i]) * 32 for i in range(64)]
+    assert h.get_block_root_at_slot(state, 9, spec) == bytes([9]) * 32
+    with pytest.raises(ValueError):
+        h.get_block_root_at_slot(state, 10, spec)  # slot !< state.slot
