@@ -1,0 +1,74 @@
+"""Incremental deposit Merkle tree (depth 32, length mix-in) + proofs.
+
+Capability mirror of the reference's deposit-tree machinery:
+`beacon_node/eth1/src/deposit_cache.rs` (incremental tree over
+DepositData roots feeding eth1-data voting and deposit proofs) and
+`consensus/merkle_proof` (branch generation/verification). The spec's
+deposit proof is the 32-level branch plus a 33rd element mixing in the
+leaf count, verified against `Eth1Data.deposit_root`.
+"""
+
+from __future__ import annotations
+
+from .config import DEPOSIT_CONTRACT_TREE_DEPTH
+from .hashing import hash32_concat
+
+ZERO_HASHES: list[bytes] = [bytes(32)]
+for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH + 1):
+    ZERO_HASHES.append(hash32_concat(ZERO_HASHES[-1], ZERO_HASHES[-1]))
+
+
+class DepositTree:
+    """Append-only Merkle tree of deposit-data roots.
+
+    Keeps every level's nodes (lists of 32-byte values) so proofs for any
+    leaf are cheap; at eth2 scale (millions of deposits) this is ~64 MB of
+    host memory, matching the reference's always-in-memory DepositCache.
+    """
+
+    def __init__(self, depth: int = DEPOSIT_CONTRACT_TREE_DEPTH):
+        self.depth = depth
+        self.levels: list[list[bytes]] = [[] for _ in range(depth + 1)]
+
+    def __len__(self) -> int:
+        return len(self.levels[0])
+
+    def push_leaf(self, leaf: bytes) -> None:
+        node = bytes(leaf)
+        self.levels[0].append(node)
+        index = len(self.levels[0]) - 1
+        for level in range(self.depth):
+            if index % 2 == 1:
+                node = hash32_concat(self.levels[level][index - 1], node)
+            else:
+                node = hash32_concat(node, ZERO_HASHES[level])
+            index //= 2
+            if index < len(self.levels[level + 1]):
+                self.levels[level + 1][index] = node
+            else:
+                self.levels[level + 1].append(node)
+
+    def root_without_length(self) -> bytes:
+        if not self.levels[0]:
+            return ZERO_HASHES[self.depth]
+        return self.levels[self.depth][0]
+
+    def root(self) -> bytes:
+        """deposit_root as the contract computes it: tree root with the
+        leaf count mixed in (hash(root ‖ uint256_le(len)))."""
+        count = len(self).to_bytes(32, "little")
+        return hash32_concat(self.root_without_length(), count)
+
+    def proof(self, index: int) -> list[bytes]:
+        """(depth+1)-element branch for leaf ``index``: 32 sibling hashes
+        bottom-up, then the length mix-in (spec Deposit.proof layout)."""
+        if not 0 <= index < len(self):
+            raise IndexError("deposit proof index out of range")
+        branch: list[bytes] = []
+        for level in range(self.depth):
+            sibling = index ^ 1
+            nodes = self.levels[level]
+            branch.append(nodes[sibling] if sibling < len(nodes) else ZERO_HASHES[level])
+            index //= 2
+        branch.append(len(self).to_bytes(32, "little"))
+        return branch

@@ -1,0 +1,208 @@
+"""Genesis state construction: from deposits, and interop (deterministic).
+
+Capability mirror of the reference's
+`consensus/state_processing/src/genesis.rs`
+(initialize_beacon_state_from_eth1 / is_valid_genesis_state /
+process_activations, incl. upgrading the genesis state when later forks
+are scheduled at epoch 0), `beacon_node/genesis/src/interop.rs:17`
+(interop_genesis_state) and `common/eth2_interop_keypairs` (sha256-of-index
+deterministic secret keys, keygen per eth2.0-pm mocked_start).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ..crypto.bls.api import SecretKey
+from ..crypto.bls.constants import R as CURVE_ORDER
+from .config import ChainSpec, GENESIS_EPOCH, compute_signing_root
+from .deposit_tree import DepositTree
+from .hashing import hash_bytes
+from . import helpers as h
+from .ssz import List as SszList, merkleize_chunks, mix_in_length
+from .types import (
+    BeaconBlockHeader,
+    Deposit,
+    DepositData,
+    DepositMessage,
+    Eth1Data,
+    Fork,
+    spec_types,
+)
+from .transition.block import apply_deposit
+from .transition.upgrade import upgrade_to_altair, upgrade_to_bellatrix
+
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+
+
+# ---------------------------------------------------------------- interop keys
+
+
+@lru_cache(maxsize=None)
+def interop_secret_key(validator_index: int) -> SecretKey:
+    """sk_i = LE-int(sha256(LE64(i) ‖ 0-pad to 32)) mod r
+    (reference: common/eth2_interop_keypairs/src/lib.rs be_private_key)."""
+    preimage = validator_index.to_bytes(8, "little") + bytes(24)
+    sk = int.from_bytes(hash_bytes(preimage), "little") % CURVE_ORDER
+    return SecretKey.from_int(sk)
+
+
+def interop_keypairs(count: int) -> list[SecretKey]:
+    return [interop_secret_key(i) for i in range(count)]
+
+
+def bls_withdrawal_credentials(pubkey: bytes) -> bytes:
+    return BLS_WITHDRAWAL_PREFIX + hash_bytes(pubkey)[1:]
+
+
+# -------------------------------------------------------------------- genesis
+
+
+INFINITY_SIGNATURE = b"\xc0" + bytes(95)
+
+
+def genesis_deposits(
+    secret_keys, amount: int, spec: ChainSpec, *, sign: bool = True
+) -> list:
+    """Signed DepositData + proofs for ``secret_keys`` (reference:
+    interop.rs interop_genesis_state's deposit construction).
+
+    ``sign=False`` writes the infinity signature instead — valid only under
+    the fake backend, exactly like the reference's fake_crypto sign
+    (impls/fake_crypto.rs returns infinity); use for fast test genesis.
+    """
+    tree = DepositTree()
+    deposits = []
+    for i, sk in enumerate(secret_keys):
+        pubkey = sk.public_key().to_bytes()
+        data = DepositData(
+            pubkey=pubkey,
+            withdrawal_credentials=bls_withdrawal_credentials(pubkey),
+            amount=amount,
+            signature=INFINITY_SIGNATURE,
+        )
+        if sign:
+            message = DepositMessage(
+                pubkey=data.pubkey,
+                withdrawal_credentials=data.withdrawal_credentials,
+                amount=data.amount,
+            )
+            domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+            signing_root = compute_signing_root(message, domain)
+            data.signature = sk.sign(signing_root).to_bytes()
+        tree.push_leaf(data.hash_tree_root())
+        # Progressive proof: genesis verifies deposit i against the root
+        # covering leaves 0..=i (spec initialize_beacon_state_from_eth1).
+        deposits.append(Deposit(proof=tree.proof(i), data=data))
+    return deposits
+
+
+def initialize_beacon_state_from_eth1(
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits: list,
+    spec: ChainSpec,
+    execution_payload_header=None,
+):
+    """Spec initialize_beacon_state_from_eth1, fork-aware (reference:
+    genesis.rs:headline fn). Builds the phase0 state, replays deposits with
+    progressive deposit roots, activates genesis validators, then upgrades
+    the container if altair/bellatrix are scheduled at epoch 0."""
+    p = spec.preset
+    t = spec_types(p)
+
+    fork = Fork(
+        previous_version=spec.GENESIS_FORK_VERSION,
+        current_version=spec.GENESIS_FORK_VERSION,
+        epoch=GENESIS_EPOCH,
+    )
+    state = t.BeaconStatePhase0(
+        genesis_time=eth1_timestamp + spec.GENESIS_DELAY,
+        fork=fork,
+        eth1_data=Eth1Data(
+            deposit_root=bytes(32),
+            deposit_count=len(deposits),
+            block_hash=eth1_block_hash,
+        ),
+        latest_block_header=BeaconBlockHeader(
+            body_root=t.BeaconBlockBodyPhase0().hash_tree_root()
+        ),
+        randao_mixes=[bytes(eth1_block_hash)] * p.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    # Replay deposits: root for deposit i covers leaves 0..=i. The
+    # incremental DepositTree gives each progressive root in O(log N), and
+    # one shared registry dict keeps apply_deposit O(1) per deposit.
+    from .transition.block import process_deposit
+
+    tree = DepositTree()
+    registry: dict = {}
+    for deposit in deposits:
+        tree.push_leaf(deposit.data.hash_tree_root())
+        state.eth1_data.deposit_root = tree.root()
+        process_deposit(state, deposit, spec, registry=registry)
+
+    process_activations(state, spec)
+    state.genesis_validators_root = t.BeaconStatePhase0.fields[
+        "validators"
+    ].hash_tree_root(state.validators)
+
+    # Scheduled-at-genesis fork upgrades (reference: genesis.rs does exactly
+    # this so post-altair networks can start directly at the later fork).
+    if spec.ALTAIR_FORK_EPOCH == 0:
+        state = upgrade_to_altair(state, spec)
+        state.fork.previous_version = spec.ALTAIR_FORK_VERSION
+        if spec.BELLATRIX_FORK_EPOCH == 0:
+            state = upgrade_to_bellatrix(state, spec)
+            state.fork.previous_version = spec.BELLATRIX_FORK_VERSION
+            if execution_payload_header is not None:
+                state.latest_execution_payload_header = execution_payload_header
+    return state
+
+
+def _deposit_list_root(leaf_roots: list[bytes]) -> bytes:
+    root = merkleize_chunks(leaf_roots, limit=2**32)
+    return mix_in_length(root, len(leaf_roots))
+
+
+def process_activations(state, spec: ChainSpec) -> None:
+    p = spec.preset
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        validator.effective_balance = min(
+            balance - balance % p.EFFECTIVE_BALANCE_INCREMENT,
+            p.MAX_EFFECTIVE_BALANCE,
+        )
+        if validator.effective_balance == p.MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = GENESIS_EPOCH
+            validator.activation_epoch = GENESIS_EPOCH
+
+
+def is_valid_genesis_state(state, spec: ChainSpec) -> bool:
+    if state.genesis_time < spec.MIN_GENESIS_TIME:
+        return False
+    active = h.get_active_validator_indices(state, GENESIS_EPOCH)
+    return len(active) >= spec.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+
+
+def interop_genesis_state(
+    secret_keys,
+    genesis_time: int,
+    spec: ChainSpec,
+    eth1_block_hash: bytes = b"\x42" * 32,
+    execution_payload_header=None,
+    sign_deposits: bool = True,
+):
+    """Deterministic-deposit genesis for testing (reference: interop.rs:17).
+    Signs one max-balance deposit per key and forces ``genesis_time``."""
+    amount = spec.preset.MAX_EFFECTIVE_BALANCE
+    deposits = genesis_deposits(secret_keys, amount, spec, sign=sign_deposits)
+    state = initialize_beacon_state_from_eth1(
+        eth1_block_hash,
+        0,
+        deposits,
+        spec,
+        execution_payload_header=execution_payload_header,
+    )
+    state.genesis_time = genesis_time
+    return state

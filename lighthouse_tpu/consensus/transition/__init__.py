@@ -1,0 +1,14 @@
+"""The beacon state transition (reference: consensus/state_processing).
+
+``per_block_processing`` / ``process_slots`` / ``process_epoch`` plus the
+fork upgrade functions; see the sibling modules for the full surface.
+"""
+
+from .block import (  # noqa: F401
+    BlockProcessingError,
+    SignatureStrategy,
+    per_block_processing,
+)
+from .epoch import process_epoch  # noqa: F401
+from .slot import SlotProcessingError, process_slot, process_slots  # noqa: F401
+from .upgrade import upgrade_to_altair, upgrade_to_bellatrix  # noqa: F401

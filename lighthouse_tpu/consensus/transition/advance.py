@@ -1,0 +1,55 @@
+"""State advance: complete (exact) and partial (shuffling-only) variants.
+
+Capability mirror of the reference's
+`consensus/state_processing/src/state_advance.rs`
+(complete_state_advance:28 / partial_state_advance:61): the chain's
+state-advance timer and attester-shuffling lookups advance a cloned state
+across empty slots; the partial variant skips tree-hashing entirely by
+writing placeholder state roots, which is sound only for consumers that
+never read state roots (committee shuffling, proposer lookup).
+"""
+
+from __future__ import annotations
+
+from ..config import ChainSpec
+from .epoch import process_epoch
+from .slot import SlotProcessingError, _maybe_upgrade, process_slot
+
+
+def complete_state_advance(
+    state, state_root: bytes | None, target_slot: int, spec: ChainSpec
+):
+    """Exact advance to ``target_slot``; ``state_root`` (if known) must be
+    hash_tree_root(state) at the current slot. Returns the advanced state."""
+    from .slot import process_slots
+
+    return process_slots(state, target_slot, spec, state_root=state_root)
+
+
+def partial_state_advance(
+    state, state_root: bytes | None, target_slot: int, spec: ChainSpec
+):
+    """Advance writing placeholder state roots (no tree hashing).
+
+    The returned state is CORRUPT for any state-root consumer and must
+    never be committed to storage or used to build/apply blocks — matching
+    the reference's warning on partial_state_advance:61.
+    """
+    if target_slot < state.slot:
+        raise SlotProcessingError("cannot rewind state")
+    # The first slot needs a real root iff the latest block header is still
+    # awaiting its state root (reference: state_advance.rs:77-90).
+    if state.slot < target_slot:
+        if state_root is None:
+            if bytes(state.latest_block_header.state_root) == bytes(32):
+                state_root = state.hash_tree_root()
+            else:
+                state_root = bytes(32)
+        while state.slot < target_slot:
+            process_slot(state, spec, state_root=state_root)
+            state_root = bytes(32)  # placeholder for subsequent slots
+            if (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0:
+                process_epoch(state, spec)
+            state.slot += 1
+            state = _maybe_upgrade(state, spec)
+    return state

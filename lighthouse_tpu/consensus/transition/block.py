@@ -100,12 +100,13 @@ def per_block_processing(
     strategy: SignatureStrategy = SignatureStrategy.VERIFY_BULK,
     get_pubkey: sigs.GetPubkey | None = None,
     backend: str | None = None,
-    verify_block_root: bool | None = None,
     caches: dict | None = None,
+    notify_new_payload=None,
 ) -> None:
     """Apply ``signed_block`` to ``state`` (already advanced to block.slot).
 
     ``caches``: optional {epoch: CommitteeCache} dict, filled on demand.
+    ``notify_new_payload``: execution-engine hook for bellatrix payloads.
     """
     block = signed_block.message
     _err(
@@ -117,12 +118,23 @@ def per_block_processing(
     col = _SigCollector(strategy, backend)
     caches = caches if caches is not None else {}
 
-    col.add(
-        sigs.block_proposal_signature_set(state, get_pubkey, signed_block, spec)
-    )
+    if strategy is not SignatureStrategy.NO_VERIFICATION:
+        # Skipping construction under NO_VERIFICATION also skips the
+        # hash_tree_root(block) it needs — the replay fast path the
+        # reference reaches via VerifyBlockRoot::False.
+        col.add(
+            sigs.block_proposal_signature_set(state, get_pubkey, signed_block, spec)
+        )
     process_block_header(state, block, spec)
-    if state_fork_name(state) == "bellatrix":
-        process_execution_payload(state, block.body.execution_payload, spec)
+    if state_fork_name(state) == "bellatrix" and is_execution_enabled(
+        state, block.body, spec
+    ):
+        process_execution_payload(
+            state,
+            block.body.execution_payload,
+            spec,
+            notify_new_payload=notify_new_payload,
+        )
     process_randao(state, block, spec, col, get_pubkey)
     process_eth1_data(state, block.body.eth1_data, spec)
     process_operations(state, block.body, spec, col, get_pubkey, caches)
@@ -235,8 +247,10 @@ def process_operations(state, body, spec, col, get_pubkey, caches) -> None:
         process_attester_slashing(state, ats, spec, col, get_pubkey)
     for att in body.attestations:
         process_attestation(state, att, spec, col, get_pubkey, caches)
-    for dep in body.deposits:
-        process_deposit(state, dep, spec)
+    if body.deposits:
+        registry = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+        for dep in body.deposits:
+            process_deposit(state, dep, spec, registry=registry, backend=col.backend)
     for exit_ in body.voluntary_exits:
         process_voluntary_exit(state, exit_, spec, col, get_pubkey)
 
@@ -481,7 +495,9 @@ def is_valid_merkle_branch(
     return value == bytes(root)
 
 
-def process_deposit(state, deposit, spec: ChainSpec) -> None:
+def process_deposit(
+    state, deposit, spec: ChainSpec, *, registry=None, backend=None
+) -> None:
     _err(
         is_valid_merkle_branch(
             deposit.data.hash_tree_root(),
@@ -493,14 +509,20 @@ def process_deposit(state, deposit, spec: ChainSpec) -> None:
         "deposit: bad merkle proof",
     )
     state.eth1_deposit_index += 1
-    apply_deposit(state, deposit.data, spec)
+    apply_deposit(state, deposit.data, spec, registry=registry, backend=backend)
 
 
-def apply_deposit(state, data, spec: ChainSpec, *, require_proof: bool = True) -> None:
+def apply_deposit(
+    state, data, spec: ChainSpec, *, registry: dict | None = None, backend=None
+) -> None:
+    """``registry``: optional {pubkey_bytes: index} map, kept up to date by
+    this function — build it once per block to avoid an O(V) scan per
+    deposit (the reference's ValidatorPubkeyCache role)."""
     pubkey = bytes(data.pubkey)
     amount = data.amount
-    registry_pubkeys = [bytes(v.pubkey) for v in state.validators]
-    if pubkey not in registry_pubkeys:
+    if registry is None:
+        registry = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    if pubkey not in registry:
         # New validator: its deposit signature must be self-consistent;
         # invalid ones are silently ignored (reference: deposits may fail
         # signature checks without invalidating the block).
@@ -508,8 +530,16 @@ def apply_deposit(state, data, spec: ChainSpec, *, require_proof: bool = True) -
         if check is None:
             return
         pk, sig, message = check
-        if not sig.to_signature().verify(pk, message):
+        # Routed through the backend seam (one-element batch) so fake/TPU
+        # backends apply here too, as in the reference where the whole BLS
+        # module is backend-parameterized (crypto/bls/src/lib.rs:131-151).
+        from ...crypto.bls.api import SignatureSet
+
+        if not verify_signature_sets(
+            [SignatureSet.single_pubkey(sig, pk, message)], backend=backend
+        ):
             return
+        registry[pubkey] = len(state.validators)
         state.validators.append(
             Validator(
                 pubkey=data.pubkey,
@@ -531,8 +561,7 @@ def apply_deposit(state, data, spec: ChainSpec, *, require_proof: bool = True) -
             state.current_epoch_participation.append(0)
             state.inactivity_scores.append(0)
     else:
-        index = registry_pubkeys.index(pubkey)
-        h.increase_balance(state, index, amount)
+        h.increase_balance(state, registry[pubkey], amount)
 
 
 # -------------------------------------------------------------------- exits
@@ -622,6 +651,22 @@ def process_sync_aggregate(state, sync_aggregate, spec, col, get_pubkey) -> None
 def is_merge_transition_complete(state, spec) -> bool:
     t = spec_types(spec.preset)
     return state.latest_execution_payload_header != t.ExecutionPayloadHeader()
+
+
+def is_merge_transition_block(state, body, spec) -> bool:
+    t = spec_types(spec.preset)
+    return not is_merge_transition_complete(state, spec) and (
+        body.execution_payload != t.ExecutionPayload()
+    )
+
+
+def is_execution_enabled(state, body, spec) -> bool:
+    """Spec (bellatrix) is_execution_enabled: payloads are processed only
+    once the merge transition has begun (pre-merge bellatrix blocks carry a
+    default payload that must be skipped, not validated)."""
+    return is_merge_transition_block(state, body, spec) or is_merge_transition_complete(
+        state, spec
+    )
 
 
 def compute_timestamp_at_slot(state, slot: int, spec) -> int:

@@ -1,0 +1,109 @@
+"""BlockReplayer — re-apply a range of blocks onto a state.
+
+Capability mirror of the reference's
+`consensus/state_processing/src/block_replayer.rs:23`: a builder used by
+the store's state reconstruction (replay from a restore point) and by
+historical queries. Options mirror the reference: skip signature
+verification (the blocks were verified when first imported), supply known
+state roots to avoid per-slot tree hashing, per-block hooks, and an
+optional target slot past the last block.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ..config import ChainSpec
+from .block import SignatureStrategy, per_block_processing
+from .slot import process_slots
+
+
+class BlockReplayError(ValueError):
+    pass
+
+
+class BlockReplayer:
+    def __init__(self, state, spec: ChainSpec):
+        self.state = state
+        self.spec = spec
+        self._strategy = SignatureStrategy.VERIFY_BULK
+        self._state_root_iter: dict[int, bytes] | None = None
+        self._pre_block_hook: Callable | None = None
+        self._post_block_hook: Callable | None = None
+        self._get_pubkey = None
+        self._caches: dict = {}
+
+    # -- builder options (reference: block_replayer.rs builder methods) ------
+    def no_signature_verification(self) -> "BlockReplayer":
+        self._strategy = SignatureStrategy.NO_VERIFICATION
+        return self
+
+    def state_root_iter(
+        self, roots: Iterable[tuple[int, bytes]]
+    ) -> "BlockReplayer":
+        """(slot, state_root) pairs covering every slot to be advanced
+        through; lets process_slots skip re-hashing (hot-path for store
+        reconstruction, reference block_replayer.rs state_root_iter)."""
+        self._state_root_iter = {int(s): r for s, r in roots}
+        return self
+
+    def pre_block_hook(self, hook: Callable) -> "BlockReplayer":
+        self._pre_block_hook = hook
+        return self
+
+    def post_block_hook(self, hook: Callable) -> "BlockReplayer":
+        self._post_block_hook = hook
+        return self
+
+    def pubkey_provider(self, get_pubkey) -> "BlockReplayer":
+        self._get_pubkey = get_pubkey
+        return self
+
+    # -- execution -----------------------------------------------------------
+    def _root_for_slot(self, slot: int) -> bytes | None:
+        if self._state_root_iter is None:
+            return None
+        return self._state_root_iter.get(slot)
+
+    def apply_blocks(
+        self, blocks: list, target_slot: int | None = None
+    ) -> "BlockReplayer":
+        """Apply ``blocks`` (ascending slots) then optionally advance to
+        ``target_slot`` (reference: block_replayer.rs apply_blocks)."""
+        for signed_block in blocks:
+            block = signed_block.message
+            if block.slot < self.state.slot:
+                raise BlockReplayError(
+                    f"block at slot {block.slot} behind state "
+                    f"slot {self.state.slot}"
+                )
+            if block.slot > self.state.slot:
+                self.state = process_slots(
+                    self.state,
+                    block.slot,
+                    self.spec,
+                    state_root=self._root_for_slot(self.state.slot),
+                )
+            if self._pre_block_hook is not None:
+                self._pre_block_hook(self.state, signed_block)
+            per_block_processing(
+                self.state,
+                signed_block,
+                self.spec,
+                strategy=self._strategy,
+                get_pubkey=self._get_pubkey,
+                caches=self._caches,
+            )
+            if self._post_block_hook is not None:
+                self._post_block_hook(self.state, signed_block)
+        if target_slot is not None and target_slot > self.state.slot:
+            self.state = process_slots(
+                self.state,
+                target_slot,
+                self.spec,
+                state_root=self._root_for_slot(self.state.slot),
+            )
+        return self
+
+    def into_state(self):
+        return self.state

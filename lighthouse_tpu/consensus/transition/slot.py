@@ -19,13 +19,18 @@ class SlotProcessingError(ValueError):
     pass
 
 
-def process_slots(state, target_slot: int, spec: ChainSpec):
+def process_slots(state, target_slot: int, spec: ChainSpec, state_root: bytes | None = None):
     """Advance ``state`` to ``target_slot`` (spec process_slots). Returns the
-    (possibly fork-upgraded) state — callers must use the return value."""
+    (possibly fork-upgraded) state — callers must use the return value.
+
+    ``state_root``, if given, is trusted as hash_tree_root(state) for the
+    *first* slot only (reference: per_slot_processing.rs takes
+    Option<Hash256> for exactly this re-hash avoidance)."""
     if target_slot < state.slot:
         raise SlotProcessingError("cannot rewind state")
     while state.slot < target_slot:
-        process_slot(state, spec)
+        process_slot(state, spec, state_root=state_root)
+        state_root = None
         if (state.slot + 1) % spec.preset.SLOTS_PER_EPOCH == 0:
             process_epoch(state, spec)
         state.slot += 1
@@ -33,10 +38,10 @@ def process_slots(state, target_slot: int, spec: ChainSpec):
     return state
 
 
-def process_slot(state, spec: ChainSpec) -> None:
+def process_slot(state, spec: ChainSpec, state_root: bytes | None = None) -> None:
     """Cache state/block roots for the current slot (spec process_slot)."""
     p = spec.preset
-    previous_state_root = state.hash_tree_root()
+    previous_state_root = state_root if state_root is not None else state.hash_tree_root()
     state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
         previous_state_root
     )

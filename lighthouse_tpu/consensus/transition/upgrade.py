@@ -1,0 +1,102 @@
+"""Fork upgrade functions: phase0 → altair → bellatrix.
+
+Capability mirror of the reference's state_processing/src/upgrade/
+{altair,merge}.rs: rebuild the state under the next fork's container,
+carrying fields over, translating phase0 pending attestations into altair
+participation flags, and initializing the sync committees / the empty
+execution-payload header.
+"""
+
+from __future__ import annotations
+
+from ..config import ChainSpec
+from .. import helpers as h
+from ..types import Fork, spec_types
+
+
+def translate_participation(post, pending_attestations, spec: ChainSpec) -> None:
+    """Replay phase0 pending attestations into altair participation flags
+    (reference: upgrade/altair.rs translate_participation)."""
+    from .block import (
+        add_flag,
+        get_attestation_participation_flag_indices,
+        has_flag,
+    )
+
+    for att in pending_attestations:
+        data = att.data
+        inclusion_delay = att.inclusion_delay
+        flag_indices = get_attestation_participation_flag_indices(
+            post, data, inclusion_delay, spec
+        )
+        indices = h.get_attesting_indices(
+            post, data, att.aggregation_bits, spec
+        )
+        for index in indices:
+            for flag_index in flag_indices:
+                if not has_flag(post.previous_epoch_participation[index], flag_index):
+                    post.previous_epoch_participation[index] = add_flag(
+                        post.previous_epoch_participation[index], flag_index
+                    )
+
+
+def upgrade_to_altair(pre, spec: ChainSpec):
+    """phase0 → altair (reference: upgrade/altair.rs upgrade_to_altair)."""
+    from .epoch import get_next_sync_committee
+
+    t = spec_types(spec.preset)
+    epoch = h.get_current_epoch(pre, spec)
+    n = len(pre.validators)
+
+    post = t.BeaconStateAltair(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.ALTAIR_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=[0] * n,
+        current_epoch_participation=[0] * n,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=[0] * n,
+    )
+    translate_participation(post, pre.previous_epoch_attestations, spec)
+
+    # Spec assigns get_next_sync_committee(post) to BOTH fields; it is a
+    # pure function of (post, spec), so compute once and copy.
+    committee = get_next_sync_committee(post, spec)
+    post.current_sync_committee = committee
+    post.next_sync_committee = committee.copy()
+    return post
+
+
+def upgrade_to_bellatrix(pre, spec: ChainSpec):
+    """altair → bellatrix (reference: upgrade/merge.rs upgrade_to_bellatrix).
+    Carries everything and adds an empty latest_execution_payload_header."""
+    t = spec_types(spec.preset)
+    epoch = h.get_current_epoch(pre, spec)
+
+    fields = {name: getattr(pre, name) for name in type(pre).fields}
+    fields["fork"] = Fork(
+        previous_version=pre.fork.current_version,
+        current_version=spec.BELLATRIX_FORK_VERSION,
+        epoch=epoch,
+    )
+    post = t.BeaconStateBellatrix(**fields)
+    return post

@@ -1,0 +1,154 @@
+"""Gossip/pool-level operation verification (state untouched).
+
+Capability mirror of the reference's
+`consensus/state_processing/src/verify_operation.rs`: the `VerifyOperation`
+trait validates an exit / proposer slashing / attester slashing against the
+head state *without mutating it* and returns a `SigVerifiedOp` that
+remembers which fork versions the signature was checked under, so the op
+pool can tell whether a stored op is still valid for a later-fork block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto.bls.api import verify_signature_sets
+from .config import ChainSpec, FAR_FUTURE_EPOCH
+from . import helpers as h
+from . import signature_sets as sigs
+from .transition.block import _registry_pubkey_provider
+
+
+class OperationError(ValueError):
+    pass
+
+
+def _err(cond: bool, msg: str) -> None:
+    if not cond:
+        raise OperationError(msg)
+
+
+def _clamped_version(fork, epoch: int) -> bytes:
+    """The fork version get_domain would use for ``epoch`` under ``fork``
+    (two-version clamp, reference: chain_spec.rs get_domain)."""
+    return bytes(fork.previous_version if epoch < fork.epoch else fork.current_version)
+
+
+@dataclass
+class SigVerifiedOp:
+    """An operation whose signature(s) were verified against ``state``'s
+    fork (reference: verify_operation.rs SigVerifiedOp). Records the actual
+    (epoch, fork_version) pairs the signature was checked under, so
+    ``is_valid_at`` can decide whether a pooled op is still valid for a
+    later-fork state: valid iff that state's get_domain clamp yields the
+    same versions."""
+
+    operation: object
+    verified_versions: list = field(default_factory=list)  # [(epoch, version)]
+
+    @classmethod
+    def new(cls, operation, state, epochs) -> "SigVerifiedOp":
+        return cls(
+            operation,
+            [(e, _clamped_version(state.fork, e)) for e in epochs],
+        )
+
+    def is_valid_at(self, state, spec: ChainSpec) -> bool:
+        return all(
+            _clamped_version(state.fork, epoch) == version
+            for epoch, version in self.verified_versions
+        )
+
+
+def _verify(sets, backend=None) -> None:
+    if sets and not verify_signature_sets(sets, backend=backend):
+        raise OperationError("operation signature invalid")
+
+
+def verify_exit(
+    state, signed_exit, spec: ChainSpec, *, verify_signature: bool = True, backend=None
+) -> SigVerifiedOp:
+    """Checks of process_voluntary_exit without the state mutation
+    (reference: per_block_processing/verify_exit.rs via verify_operation.rs)."""
+    exit_msg = signed_exit.message
+    current = h.get_current_epoch(state, spec)
+    _err(exit_msg.validator_index < len(state.validators), "exit: unknown validator")
+    v = state.validators[exit_msg.validator_index]
+    _err(h.is_active_validator(v, current), "exit: not active")
+    _err(v.exit_epoch == FAR_FUTURE_EPOCH, "exit: already exiting")
+    _err(current >= exit_msg.epoch, "exit: not yet valid")
+    _err(
+        current >= v.activation_epoch + spec.preset.SHARD_COMMITTEE_PERIOD,
+        "exit: too young",
+    )
+    if verify_signature:
+        get_pubkey = _registry_pubkey_provider(state)
+        _verify([sigs.exit_signature_set(state, get_pubkey, signed_exit, spec)], backend)
+    return SigVerifiedOp.new(signed_exit, state, [exit_msg.epoch])
+
+
+def verify_proposer_slashing(
+    state, slashing, spec: ChainSpec, *, verify_signature: bool = True, backend=None
+) -> SigVerifiedOp:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _err(h1.slot == h2.slot, "proposer slashing: slot mismatch")
+    _err(h1.proposer_index == h2.proposer_index, "proposer slashing: proposer mismatch")
+    _err(h1 != h2, "proposer slashing: identical headers")
+    _err(h1.proposer_index < len(state.validators), "proposer slashing: unknown validator")
+    proposer = state.validators[h1.proposer_index]
+    _err(
+        h.is_slashable_validator(proposer, h.get_current_epoch(state, spec)),
+        "proposer slashing: not slashable",
+    )
+    if verify_signature:
+        get_pubkey = _registry_pubkey_provider(state)
+        _verify(
+            list(sigs.proposer_slashing_signature_sets(state, get_pubkey, slashing, spec)),
+            backend,
+        )
+    epochs = [
+        h.compute_epoch_at_slot(h1.slot, spec),
+        h.compute_epoch_at_slot(h2.slot, spec),
+    ]
+    return SigVerifiedOp.new(slashing, state, epochs)
+
+
+def verify_attester_slashing(
+    state, slashing, spec: ChainSpec, *, verify_signature: bool = True, backend=None
+) -> SigVerifiedOp:
+    """Returns the SigVerifiedOp; ``slashable_indices(state, slashing,
+    spec)`` gives the actually-slashable intersection."""
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _err(
+        h.is_slashable_attestation_data(a1.data, a2.data),
+        "attester slashing: not slashable data",
+    )
+    for att in (a1, a2):
+        _err(
+            h.is_valid_indexed_attestation_structure(att, spec),
+            "attester slashing: malformed indexed attestation",
+        )
+    _err(bool(slashable_indices(state, slashing, spec)), "attester slashing: no one slashable")
+    if verify_signature:
+        get_pubkey = _registry_pubkey_provider(state)
+        _verify(
+            list(sigs.attester_slashing_signature_sets(state, get_pubkey, slashing, spec)),
+            backend,
+        )
+    return SigVerifiedOp.new(
+        slashing, state, [a1.data.target.epoch, a2.data.target.epoch]
+    )
+
+
+def slashable_indices(state, slashing, spec: ChainSpec) -> list[int]:
+    epoch = h.get_current_epoch(state, spec)
+    common = set(slashing.attestation_1.attesting_indices) & set(
+        slashing.attestation_2.attesting_indices
+    )
+    return sorted(
+        i
+        for i in common
+        if i < len(state.validators)
+        and h.is_slashable_validator(state.validators[i], epoch)
+    )

@@ -79,16 +79,67 @@ class AffinePoint:
         return AffinePoint(x3, y3, False, self.b)
 
     def mul(self, k: int) -> "AffinePoint":
+        """Scalar multiplication via a Jacobian-coordinate ladder (one field
+        inversion total, vs one per affine add — the batched device versions
+        live in lighthouse_tpu/ops/points.py)."""
         if k < 0:
             return self.neg().mul(-k)
-        acc = AffinePoint.infinity_point(type(self.x), self.b)
-        base = self
-        while k:
-            if k & 1:
-                acc = acc.add(base)
-            base = base.double()
-            k >>= 1
-        return acc
+        if k == 0 or self.infinity:
+            return AffinePoint.infinity_point(type(self.x), self.b)
+
+        field = type(self.x)
+        one = field.one()
+        zero = field.zero()
+
+        def jac_double(X1, Y1, Z1):
+            # dbl-2009-l
+            A = X1.square()
+            B = Y1.square()
+            C = B.square()
+            D = ((X1 + B).square() - A - C).mul_scalar(2)
+            E = A.mul_scalar(3)
+            X3 = E.square() - D.mul_scalar(2)
+            Y3 = E * (D - X3) - C.mul_scalar(8)
+            Z3 = (Y1 * Z1).mul_scalar(2)
+            return X3, Y3, Z3
+
+        # Jacobian accumulator (X, Y, Z); Z == zero means infinity.
+        X1, Y1, Z1 = zero, one, zero
+        x2, y2 = self.x, self.y
+
+        for bit in bin(k)[2:]:
+            if not Z1.is_zero():
+                X1, Y1, Z1 = jac_double(X1, Y1, Z1)
+            if bit == "1":
+                # mixed add, madd-2007-bl (Jacobian += affine)
+                if Z1.is_zero():
+                    X1, Y1, Z1 = x2, y2, one
+                else:
+                    Z1Z1 = Z1.square()
+                    U2 = x2 * Z1Z1
+                    S2 = y2 * Z1 * Z1Z1
+                    H = U2 - X1
+                    r = (S2 - Y1).mul_scalar(2)
+                    if H.is_zero():
+                        if r.is_zero():
+                            X1, Y1, Z1 = jac_double(X1, Y1, Z1)
+                        else:
+                            X1, Y1, Z1 = zero, one, zero
+                    else:
+                        HH = H.square()
+                        I = HH.mul_scalar(4)
+                        J = H * I
+                        V = X1 * I
+                        X3 = r.square() - J - V.mul_scalar(2)
+                        Y3 = r * (V - X3) - (Y1 * J).mul_scalar(2)
+                        Z3 = (Z1 + H).square() - Z1Z1 - HH
+                        X1, Y1, Z1 = X3, Y3, Z3
+
+        if Z1.is_zero():
+            return AffinePoint.infinity_point(field, self.b)
+        zinv = Z1.inv()
+        zinv2 = zinv.square()
+        return AffinePoint(X1 * zinv2, Y1 * zinv2 * zinv, False, self.b)
 
 
 FQ_B1 = Fq(B1)

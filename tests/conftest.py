@@ -39,3 +39,19 @@ jax.config.update(
     os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fake_backend():
+    """Run the test under the always-valid fake BLS backend (reference:
+    fake_crypto feature used by ef_tests/state-transition CI, Makefile:103)."""
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        yield
+    finally:
+        backends._default = prev

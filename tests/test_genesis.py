@@ -1,0 +1,109 @@
+"""Genesis construction: deposit tree, interop keys, state init.
+
+Mirrors the reference's genesis coverage (state_processing genesis.rs unit
+tests + beacon_node/genesis interop tests: validator count, activation,
+deposit-root consistency, determinism).
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.deposit_tree import DepositTree, ZERO_HASHES
+from lighthouse_tpu.consensus.genesis import (
+    _deposit_list_root,
+    bls_withdrawal_credentials,
+    genesis_deposits,
+    interop_genesis_state,
+    interop_keypairs,
+    interop_secret_key,
+    is_valid_genesis_state,
+)
+from lighthouse_tpu.consensus.transition.block import is_valid_merkle_branch
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+def test_deposit_tree_matches_ssz_list_root():
+    import os
+
+    leaves = [os.urandom(32) for _ in range(13)]
+    tree = DepositTree()
+    for i, leaf in enumerate(leaves):
+        tree.push_leaf(leaf)
+        assert tree.root() == _deposit_list_root(leaves[: i + 1])
+
+
+def test_deposit_tree_empty_root():
+    assert DepositTree().root_without_length() == ZERO_HASHES[32]
+
+
+def test_deposit_proofs_verify(spec):
+    import os
+
+    tree = DepositTree()
+    leaves = [os.urandom(32) for _ in range(9)]
+    for i, leaf in enumerate(leaves):
+        tree.push_leaf(leaf)
+        # proof for the latest leaf against the current root
+        proof = tree.proof(i)
+        assert is_valid_merkle_branch(leaf, proof, 33, i, tree.root())
+    # proofs for older leaves against the final root
+    for i, leaf in enumerate(leaves):
+        assert is_valid_merkle_branch(leaf, tree.proof(i), 33, i, tree.root())
+
+
+def test_interop_keys_deterministic():
+    a = interop_secret_key(3)
+    b = interop_secret_key(3)
+    assert a.to_bytes() == b.to_bytes()
+    keys = interop_keypairs(4)
+    assert len({k.to_bytes() for k in keys}) == 4
+
+
+def test_interop_genesis_state(spec, fake_backend):
+    keys = interop_keypairs(8)
+    state = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+    assert len(state.validators) == 8
+    assert len(state.balances) == 8
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert all(
+        v.effective_balance == spec.preset.MAX_EFFECTIVE_BALANCE
+        for v in state.validators
+    )
+    assert state.eth1_deposit_index == 8
+    assert state.genesis_time == 1_600_000_000
+    assert bytes(state.genesis_validators_root) != bytes(32)
+    # deterministic
+    state2 = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+    assert state.hash_tree_root() == state2.hash_tree_root()
+
+
+def test_genesis_withdrawal_credentials(spec):
+    sk = interop_secret_key(0)
+    creds = bls_withdrawal_credentials(sk.public_key().to_bytes())
+    assert creds[0:1] == b"\x00"
+    assert len(creds) == 32
+
+
+def test_signed_genesis_deposit_roundtrip(spec):
+    """With the real (python) backend, signed deposits must be accepted and
+    unsigned ones silently dropped (reference: deposits may legally carry
+    invalid signatures — apply_deposit ignores them)."""
+    keys = interop_keypairs(2)
+    state = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=True)
+    assert len(state.validators) == 2
+
+    bad = interop_genesis_state(
+        keys, 1_600_000_000, spec, sign_deposits=False
+    )
+    assert len(bad.validators) == 0  # infinity signature rejected by python backend
+
+
+def test_is_valid_genesis_state(spec, fake_backend):
+    keys = interop_keypairs(4)
+    state = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+    # minimal spec needs 64 active validators; 4 is insufficient
+    assert not is_valid_genesis_state(state, spec)

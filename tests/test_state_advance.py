@@ -1,0 +1,104 @@
+"""State advance (complete/partial), slot processing, fork upgrades.
+
+Mirrors the reference's state_advance.rs + per_slot_processing.rs + the
+sanity_slots ef_tests tier: empty-slot advances are exact, partial advances
+agree on shuffling-relevant fields, epoch boundaries fire, and scheduled
+forks upgrade the container.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.genesis import interop_genesis_state, interop_keypairs
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus.transition.advance import (
+    complete_state_advance,
+    partial_state_advance,
+)
+from lighthouse_tpu.consensus.transition.slot import (
+    SlotProcessingError,
+    process_slots,
+)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def genesis_state(spec):
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        keys = interop_keypairs(16)
+        return interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+    finally:
+        backends._default = prev
+
+
+def test_process_slots_advances(genesis_state, spec, fake_backend):
+    state = genesis_state.copy()
+    state = process_slots(state, 3, spec)
+    assert state.slot == 3
+    # roots were cached
+    assert bytes(state.state_roots[0]) != bytes(32)
+    assert bytes(state.block_roots[0]) != bytes(32)
+
+
+def test_process_slots_cannot_rewind(genesis_state, spec):
+    state = genesis_state.copy()
+    state = process_slots(state, 2, spec)
+    with pytest.raises(SlotProcessingError):
+        process_slots(state, 1, spec)
+
+
+def test_epoch_boundary_fires(genesis_state, spec, fake_backend):
+    state = genesis_state.copy()
+    slots = spec.preset.SLOTS_PER_EPOCH
+    state = process_slots(state, slots, spec)
+    assert h.get_current_epoch(state, spec) == 1
+
+
+def test_complete_advance_trusts_state_root(genesis_state, spec, fake_backend):
+    state_a = genesis_state.copy()
+    root = state_a.hash_tree_root()
+    state_a = complete_state_advance(state_a, root, 2, spec)
+    state_b = complete_state_advance(genesis_state.copy(), None, 2, spec)
+    assert state_a.hash_tree_root() == state_b.hash_tree_root()
+
+
+def test_partial_advance_shuffling_agrees(genesis_state, spec, fake_backend):
+    slots = spec.preset.SLOTS_PER_EPOCH * 2 + 3
+    exact = complete_state_advance(genesis_state.copy(), None, slots, spec)
+    partial = partial_state_advance(genesis_state.copy(), None, slots, spec)
+    assert partial.slot == exact.slot
+    # shuffling-relevant fields agree even though roots are placeholders
+    assert bytes(partial.randao_mixes[0]) == bytes(exact.randao_mixes[0])
+    assert [v.effective_balance for v in partial.validators] == [
+        v.effective_balance for v in exact.validators
+    ]
+    epoch = h.get_current_epoch(exact, spec)
+    assert h.get_beacon_proposer_index(partial, spec) == h.get_beacon_proposer_index(
+        exact, spec
+    )
+    assert list(h.get_active_validator_indices(partial, epoch)) == list(
+        h.get_active_validator_indices(exact, epoch)
+    )
+
+
+def test_scheduled_fork_upgrades(genesis_state, spec, fake_backend):
+    import dataclasses
+
+    forked = dataclasses.replace(spec, ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2)
+    state = genesis_state.copy()
+    state = process_slots(state, forked.preset.SLOTS_PER_EPOCH, forked)
+    assert type(state).fork_name == "altair"
+    assert bytes(state.fork.current_version) == forked.ALTAIR_FORK_VERSION
+    assert len(state.inactivity_scores) == len(state.validators)
+    state = process_slots(state, 2 * forked.preset.SLOTS_PER_EPOCH, forked)
+    assert type(state).fork_name == "bellatrix"
+    assert bytes(state.fork.current_version) == forked.BELLATRIX_FORK_VERSION
+    assert bytes(state.latest_execution_payload_header.block_hash) == bytes(32)

@@ -1,0 +1,130 @@
+"""Pool-level operation verification (verify_operation.rs equivalent)."""
+
+import pytest
+
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.genesis import interop_genesis_state, interop_keypairs
+from lighthouse_tpu.consensus.types import (
+    SignedVoluntaryExit,
+    VoluntaryExit,
+)
+from lighthouse_tpu.consensus.verify_operation import (
+    OperationError,
+    verify_exit,
+)
+from lighthouse_tpu.consensus.transition.slot import process_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return interop_keypairs(16)
+
+
+@pytest.fixture(scope="module")
+def exitable_state(spec, keys):
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        state = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+        # advance past SHARD_COMMITTEE_PERIOD epochs so exits are allowed
+        target = spec.preset.SHARD_COMMITTEE_PERIOD * spec.preset.SLOTS_PER_EPOCH
+        state = process_slots(state, target, spec)
+        return state
+    finally:
+        backends._default = prev
+
+
+def _signed_exit(state, keys, spec, index=0, epoch=None):
+    from lighthouse_tpu.consensus import helpers as h
+
+    exit_msg = VoluntaryExit(
+        epoch=epoch if epoch is not None else h.get_current_epoch(state, spec),
+        validator_index=index,
+    )
+    domain = spec.get_domain(
+        spec.DOMAIN_VOLUNTARY_EXIT,
+        exit_msg.epoch,
+        state.fork,
+        bytes(state.genesis_validators_root),
+    )
+    from lighthouse_tpu.consensus.config import compute_signing_root
+
+    signing_root = compute_signing_root(exit_msg, domain)
+    sig = keys[index].sign(signing_root)
+    return SignedVoluntaryExit(message=exit_msg, signature=sig.to_bytes())
+
+
+def test_valid_exit_verifies(exitable_state, keys, spec):
+    exit_ = _signed_exit(exitable_state, keys, spec, index=1)
+    op = verify_exit(exitable_state, exit_, spec)
+    assert op.operation is exit_
+    assert op.is_valid_at(exitable_state, spec)
+
+
+def test_bad_signature_rejected(exitable_state, keys, spec):
+    exit_ = _signed_exit(exitable_state, keys, spec, index=1)
+    exit_.signature = keys[2].sign(b"\x01" * 32).to_bytes()
+    with pytest.raises(OperationError):
+        verify_exit(exitable_state, exit_, spec)
+
+
+def test_unknown_validator_rejected(exitable_state, keys, spec):
+    exit_ = _signed_exit(exitable_state, keys, spec, index=1)
+    exit_.message.validator_index = 10_000
+    with pytest.raises(OperationError):
+        verify_exit(exitable_state, exit_, spec, verify_signature=False)
+
+
+def test_too_young_rejected(spec, keys, fake_backend):
+    state = interop_genesis_state(keys, 1_600_000_000, spec, sign_deposits=False)
+    exit_ = _signed_exit(state, keys, spec, index=1, epoch=0)
+    with pytest.raises(OperationError):
+        verify_exit(state, exit_, spec, verify_signature=False)
+
+
+def test_is_valid_at_across_forks(exitable_state, keys, spec):
+    """An op verified under the same clamped fork version a later state
+    would use must remain valid there (regression: is_valid_at used to
+    compare against the unclamped historical schedule)."""
+    import dataclasses
+
+    from lighthouse_tpu.consensus.types import Fork
+
+    exit_ = _signed_exit(exitable_state, keys, spec, index=3)
+    op = verify_exit(exitable_state, exit_, spec, verify_signature=False)
+    assert op.is_valid_at(exitable_state, spec)
+
+    # Simulate a later-fork state whose previous_version still covers the
+    # op's epoch: clamp yields the same version -> still valid.
+    later = exitable_state.copy()
+    later.fork = Fork(
+        previous_version=exitable_state.fork.current_version,
+        current_version=b"\x01\x00\x00\x01",
+        epoch=exit_.message.epoch + 1,
+    )
+    assert op.is_valid_at(later, spec)
+
+    # A fork whose clamp yields a different version invalidates the op.
+    changed = exitable_state.copy()
+    changed.fork = Fork(
+        previous_version=b"\x09\x00\x00\x00",
+        current_version=b"\x0a\x00\x00\x00",
+        epoch=0,
+    )
+    assert not op.is_valid_at(changed, spec)
+
+
+def test_future_epoch_exit_rejected(exitable_state, keys, spec):
+    from lighthouse_tpu.consensus import helpers as h
+
+    future = h.get_current_epoch(exitable_state, spec) + 10
+    exit_ = _signed_exit(exitable_state, keys, spec, index=1, epoch=future)
+    with pytest.raises(OperationError):
+        verify_exit(exitable_state, exit_, spec, verify_signature=False)
