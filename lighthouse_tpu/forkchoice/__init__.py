@@ -1,0 +1,23 @@
+"""Fork choice: proto-array DAG + spec wrapper.
+
+Capability mirror of the reference's `consensus/proto_array` (the node-list
+DAG with delta-based score propagation and greedy best-descendant head
+walk) and `consensus/fork_choice` (the spec on_block/on_attestation/
+get_head state machine over it).
+"""
+
+from .proto_array import (  # noqa: F401
+    ExecutionStatus,
+    ProtoArray,
+    ProtoArrayForkChoice,
+    ProtoArrayError,
+    ProtoBlock,
+    VoteTracker,
+    compute_deltas,
+)
+from .fork_choice import (  # noqa: F401
+    ForkChoice,
+    ForkChoiceError,
+    ForkChoiceStore,
+    QueuedAttestation,
+)

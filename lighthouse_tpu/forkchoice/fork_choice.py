@@ -1,0 +1,367 @@
+"""Spec fork choice over the proto-array (reference: consensus/fork_choice).
+
+`ForkChoice` drives a `ProtoArrayForkChoice` per the consensus spec's
+fork-choice rules as the reference implements them
+(fork_choice.rs:283 ForkChoice, :471 get_head, :623 on_block,
+:918 on_attestation): checkpoint bookkeeping (justified / best-justified /
+finalized with the SAFE_SLOTS_TO_UPDATE_JUSTIFIED rule of this spec era),
+attestation validation + one-slot queuing, proposer boost, and
+execution-status plumbing for optimistic import.
+
+`ForkChoiceStore` is the reference's ForkChoiceStore trait
+(fork_choice_store.rs) as a concrete object: the chain supplies a
+``justified_balances_fn(checkpoint) -> balances`` so the store can refresh
+effective balances when the justified checkpoint moves (the reference's
+BeaconForkChoiceStore does this against the store/state cache).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..consensus.config import ChainSpec
+from .proto_array import (
+    ExecutionStatus,
+    ProtoArrayError,
+    ProtoArrayForkChoice,
+    ProtoBlock,
+)
+
+SAFE_SLOTS_TO_UPDATE_JUSTIFIED = 8
+ZERO_ROOT = b"\x00" * 32
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+class InvalidAttestation(ForkChoiceError):
+    pass
+
+
+class InvalidBlock(ForkChoiceError):
+    pass
+
+
+@dataclass
+class QueuedAttestation:
+    """Attestation waiting for the next slot (spec: attestations can only
+    influence fork choice from the slot after they were made; reference:
+    fork_choice.rs QueuedAttestation)."""
+
+    slot: int
+    attesting_indices: list[int]
+    block_root: bytes
+    target_epoch: int
+
+
+@dataclass
+class ForkChoiceStore:
+    justified_checkpoint: tuple[int, bytes]
+    finalized_checkpoint: tuple[int, bytes]
+    best_justified_checkpoint: tuple[int, bytes]
+    justified_balances: list[int]
+    proposer_boost_root: bytes = ZERO_ROOT
+    equivocating_indices: set[int] = field(default_factory=set)
+    balances_fn: Callable | None = None
+
+    def refresh_justified_balances(self) -> None:
+        if self.balances_fn is not None:
+            self.justified_balances = list(self.balances_fn(self.justified_checkpoint))
+
+
+def _checkpoint(cp) -> tuple[int, bytes]:
+    """Normalize a types.Checkpoint container to (epoch, root)."""
+    return (int(cp.epoch), bytes(cp.root))
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        store: ForkChoiceStore,
+        proto: ProtoArrayForkChoice,
+        spec: ChainSpec,
+        genesis_time: int,
+    ):
+        self.store = store
+        self.proto = proto
+        self.spec = spec
+        self.genesis_time = genesis_time
+        self.queued_attestations: list[QueuedAttestation] = []
+        self._current_slot = 0
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def from_anchor(
+        cls,
+        anchor_state,
+        anchor_block_root: bytes,
+        spec: ChainSpec,
+        balances_fn: Callable | None = None,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+    ) -> "ForkChoice":
+        """Initialize from a (genesis or checkpoint-sync) anchor
+        (reference: fork_choice.rs from_anchor)."""
+        from ..consensus import helpers as h
+
+        epoch = h.compute_epoch_at_slot(int(anchor_state.slot), spec)
+        cp = (epoch, anchor_block_root)
+        # Spec justified balances: ACTIVE validators only — exited/slashed
+        # validators keep a nonzero effective_balance but must not weigh in.
+        store = ForkChoiceStore(
+            justified_checkpoint=cp,
+            finalized_checkpoint=cp,
+            best_justified_checkpoint=cp,
+            justified_balances=[
+                int(v.effective_balance) if h.is_active_validator(v, epoch) else 0
+                for v in anchor_state.validators
+            ],
+            balances_fn=balances_fn,
+        )
+        anchor_block = ProtoBlock(
+            slot=int(anchor_state.slot),
+            root=anchor_block_root,
+            parent_root=None,
+            state_root=bytes(anchor_state.hash_tree_root()),
+            target_root=anchor_block_root,
+            justified_checkpoint=cp,
+            finalized_checkpoint=cp,
+            execution_status=execution_status,
+        )
+        proto = ProtoArrayForkChoice(anchor_block, cp, cp)
+        fc = cls(store, proto, spec, int(anchor_state.genesis_time))
+        fc._current_slot = int(anchor_state.slot)
+        return fc
+
+    # ------------------------------------------------------------- ticking
+    def update_time(self, current_slot: int) -> None:
+        """Advance to ``current_slot``, dequeuing attestations and applying
+        per-slot/per-epoch store updates (reference: fork_choice.rs
+        update_time/on_tick)."""
+        while self._current_slot < current_slot:
+            self._on_tick(self._current_slot + 1)
+        self._process_queued_attestations()
+
+    def _on_tick(self, slot: int) -> None:
+        self._current_slot = slot
+        # Proposer boost is one slot only.
+        self.store.proposer_boost_root = ZERO_ROOT
+        p = self.spec.preset
+        if slot % p.SLOTS_PER_EPOCH == 0:
+            if (
+                self.store.best_justified_checkpoint[0]
+                > self.store.justified_checkpoint[0]
+            ):
+                self.store.justified_checkpoint = (
+                    self.store.best_justified_checkpoint
+                )
+                self.store.refresh_justified_balances()
+
+    def _process_queued_attestations(self) -> None:
+        remaining = []
+        for qa in self.queued_attestations:
+            if qa.slot < self._current_slot:
+                for index in qa.attesting_indices:
+                    if index not in self.store.equivocating_indices:
+                        self.proto.process_attestation(
+                            index, qa.block_root, qa.target_epoch
+                        )
+            else:
+                remaining.append(qa)
+        self.queued_attestations = remaining
+
+    # ------------------------------------------------------------- on_block
+    def on_block(
+        self,
+        current_slot: int,
+        block,
+        block_root: bytes,
+        state,
+        *,
+        block_delay_seconds: float | None = None,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+        execution_block_hash: bytes | None = None,
+    ) -> None:
+        """Register an imported block (reference: fork_choice.rs:623).
+        ``state`` is the post-state of the block."""
+        from ..consensus import helpers as h
+
+        self.update_time(current_slot)
+        if int(block.slot) > current_slot:
+            raise InvalidBlock("block from the future")
+        finalized_slot = self._epoch_start_slot(self.store.finalized_checkpoint[0])
+        if int(block.slot) <= finalized_slot:
+            raise InvalidBlock("block older than finalization")
+        parent_root = bytes(block.parent_root)
+        if not self.proto.contains_block(parent_root):
+            raise InvalidBlock("unknown parent")
+        if not self.proto.is_descendant(
+            self.store.finalized_checkpoint[1], parent_root
+        ):
+            raise InvalidBlock("block does not descend from finalized root")
+
+        # Proposer boost: first timely block for this slot
+        # (spec on_block; reference fork_choice.rs:700-720).
+        if block_delay_seconds is not None:
+            timely = (
+                block_delay_seconds < self.spec.SECONDS_PER_SLOT / 3
+                and int(block.slot) == current_slot
+            )
+            if timely and self.store.proposer_boost_root == ZERO_ROOT:
+                self.store.proposer_boost_root = block_root
+
+        justified = _checkpoint(state.current_justified_checkpoint)
+        finalized = _checkpoint(state.finalized_checkpoint)
+        if justified[0] > self.store.best_justified_checkpoint[0]:
+            self.store.best_justified_checkpoint = justified
+        if self._should_update_justified_checkpoint(current_slot, justified):
+            self.store.justified_checkpoint = justified
+            self.store.refresh_justified_balances()
+        if finalized[0] > self.store.finalized_checkpoint[0]:
+            self.store.finalized_checkpoint = finalized
+            if (
+                justified[0] > self.store.justified_checkpoint[0]
+                or not self.proto.is_descendant(
+                    finalized[1], self.store.justified_checkpoint[1]
+                )
+            ):
+                self.store.justified_checkpoint = justified
+                self.store.refresh_justified_balances()
+
+        epoch = h.compute_epoch_at_slot(int(block.slot), self.spec)
+        epoch_start = self._epoch_start_slot(epoch)
+        if int(block.slot) == epoch_start:
+            target_root = block_root
+        else:
+            target_root = bytes(
+                h.get_block_root_at_slot(state, epoch_start, self.spec)
+            )
+        self.proto.process_block(
+            ProtoBlock(
+                slot=int(block.slot),
+                root=block_root,
+                parent_root=parent_root,
+                state_root=bytes(block.state_root),
+                target_root=target_root,
+                justified_checkpoint=justified,
+                finalized_checkpoint=finalized,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+        )
+
+    def _should_update_justified_checkpoint(
+        self, current_slot: int, new_justified: tuple[int, bytes]
+    ) -> bool:
+        """SAFE_SLOTS_TO_UPDATE_JUSTIFIED rule of this spec era
+        (reference: fork_choice.rs should_update_justified_checkpoint)."""
+        if new_justified[0] <= self.store.justified_checkpoint[0]:
+            return False
+        p = self.spec.preset
+        if current_slot % p.SLOTS_PER_EPOCH < SAFE_SLOTS_TO_UPDATE_JUSTIFIED:
+            return True
+        justified_slot = self._epoch_start_slot(self.store.justified_checkpoint[0])
+        if not self.proto.contains_block(new_justified[1]):
+            return False
+        # New justified must descend from the old one to fast-update.
+        return self.proto.is_descendant(
+            self.store.justified_checkpoint[1], new_justified[1]
+        ) and self.proto.get_block(new_justified[1]).slot > justified_slot
+
+    # ------------------------------------------------------- on_attestation
+    def on_attestation(
+        self, current_slot: int, indexed_attestation, *, is_from_block: bool = False
+    ) -> None:
+        """Apply an indexed attestation's LMD votes
+        (reference: fork_choice.rs:918)."""
+        self.update_time(current_slot)
+        data = indexed_attestation.data
+        self._validate_on_attestation(current_slot, data, is_from_block)
+        if int(data.slot) < current_slot:
+            for index in indexed_attestation.attesting_indices:
+                if int(index) not in self.store.equivocating_indices:
+                    self.proto.process_attestation(
+                        int(index), bytes(data.beacon_block_root), int(data.target.epoch)
+                    )
+        else:
+            self.queued_attestations.append(
+                QueuedAttestation(
+                    slot=int(data.slot),
+                    attesting_indices=[int(i) for i in indexed_attestation.attesting_indices],
+                    block_root=bytes(data.beacon_block_root),
+                    target_epoch=int(data.target.epoch),
+                )
+            )
+
+    def _validate_on_attestation(self, current_slot: int, data, is_from_block: bool) -> None:
+        from ..consensus import helpers as h
+
+        p = self.spec.preset
+        target = data.target
+        if not is_from_block:
+            current_epoch = current_slot // p.SLOTS_PER_EPOCH
+            if int(target.epoch) not in (current_epoch, max(current_epoch - 1, 0)):
+                raise InvalidAttestation("target epoch not current or previous")
+        if int(target.epoch) != int(data.slot) // p.SLOTS_PER_EPOCH:
+            raise InvalidAttestation("target epoch does not match slot")
+        if not self.proto.contains_block(bytes(target.root)):
+            raise InvalidAttestation("unknown target root")
+        block = self.proto.get_block(bytes(data.beacon_block_root))
+        if block is None:
+            raise InvalidAttestation("unknown head block")
+        if block.slot > int(data.slot):
+            raise InvalidAttestation("attestation for a future block")
+        if block.execution_status is ExecutionStatus.INVALID:
+            raise InvalidAttestation("attestation to invalid-execution block")
+
+    def on_attester_slashing(self, attester_slashing) -> None:
+        """Equivocating validators stop counting (spec on_attester_slashing;
+        reference: fork_choice.rs on_attester_slashing)."""
+        common = set(
+            int(i) for i in attester_slashing.attestation_1.attesting_indices
+        ) & set(int(i) for i in attester_slashing.attestation_2.attesting_indices)
+        for index in common:
+            self.store.equivocating_indices.add(index)
+            # Retract the validator's existing vote weight.
+            if index < len(self.proto.votes):
+                self.proto.votes[index].next_root = ZERO_ROOT
+                self.proto.votes[index].next_epoch = 0
+
+    # ------------------------------------------------------------- get_head
+    def get_head(self, current_slot: int) -> bytes:
+        """Run find_head from the justified checkpoint
+        (reference: fork_choice.rs:471)."""
+        self.update_time(current_slot)
+        return self.proto.find_head(
+            self.store.justified_checkpoint,
+            self.store.finalized_checkpoint,
+            self.store.justified_balances,
+            self.store.proposer_boost_root,
+            current_slot,
+            self.spec,
+        )
+
+    # ----------------------------------------------------------- execution
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        self.proto.proto_array.process_execution_payload_validation(root)
+
+    def on_invalid_execution_payload(
+        self, root: bytes, latest_valid_hash: bytes | None = None
+    ) -> None:
+        self.proto.proto_array.process_execution_payload_invalidation(
+            root, latest_valid_hash
+        )
+
+    # ------------------------------------------------------------- queries
+    def contains_block(self, root: bytes) -> bool:
+        return self.proto.contains_block(root)
+
+    def get_block(self, root: bytes) -> ProtoBlock | None:
+        return self.proto.get_block(root)
+
+    def prune(self) -> None:
+        self.proto.proto_array.maybe_prune(self.store.finalized_checkpoint[1])
+
+    def _epoch_start_slot(self, epoch: int) -> int:
+        return epoch * self.spec.preset.SLOTS_PER_EPOCH

@@ -1,0 +1,477 @@
+"""Proto-array fork choice: the DAG, vote deltas, and head selection.
+
+Capability mirror of the reference's `consensus/proto_array`:
+
+* `ProtoArray` — append-only node list; each node caches ``weight``,
+  ``best_child`` and ``best_descendant`` so head selection is O(1) from any
+  start node after an `apply_score_changes` pass
+  (proto_array.rs:143 apply_score_changes, :293 on_block, :607 find_head).
+* `ProtoArrayForkChoice` — vote tracking (one `VoteTracker` per validator),
+  balance-aware delta computation, proposer boost
+  (proto_array_fork_choice.rs:157,255).
+* `compute_deltas` — the classic score-delta algorithm over changed votes
+  and changed balances (one pass over the validator dimension).
+
+Execution-status tracking (Valid / Invalid / Optimistic / Irrelevant)
+follows the reference's post-merge `ExecutionStatus` handling: invalidated
+payloads poison their descendants and are never viable for head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+
+class ProtoArrayError(ValueError):
+    pass
+
+
+class ExecutionStatus(Enum):
+    # Pre-merge blocks / no payload (reference: execution_status.rs Irrelevant).
+    IRRELEVANT = "irrelevant"
+    # Payload present, engine said VALID.
+    VALID = "valid"
+    # Payload present, engine undecided (syncing) — optimistic import.
+    OPTIMISTIC = "optimistic"
+    # Payload present, engine said INVALID.
+    INVALID = "invalid"
+
+
+@dataclass
+class ProtoBlock:
+    """Everything fork choice remembers about a block
+    (reference: proto_array/src/proto_array_fork_choice.rs Block)."""
+
+    slot: int
+    root: bytes
+    parent_root: bytes | None
+    state_root: bytes
+    target_root: bytes
+    justified_checkpoint: tuple[int, bytes]  # (epoch, root)
+    finalized_checkpoint: tuple[int, bytes]
+    execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT
+    execution_block_hash: bytes | None = None
+
+
+@dataclass
+class _Node:
+    slot: int
+    root: bytes
+    state_root: bytes
+    target_root: bytes
+    parent: int | None
+    justified_checkpoint: tuple[int, bytes]
+    finalized_checkpoint: tuple[int, bytes]
+    weight: int = 0
+    best_child: int | None = None
+    best_descendant: int | None = None
+    execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT
+    execution_block_hash: bytes | None = None
+
+
+@dataclass
+class VoteTracker:
+    """Latest-message tracking for one validator
+    (reference: proto_array_fork_choice.rs VoteTracker)."""
+
+    current_root: bytes = b"\x00" * 32
+    next_root: bytes = b"\x00" * 32
+    next_epoch: int = 0
+
+
+def compute_deltas(
+    indices: dict[bytes, int],
+    votes: list[VoteTracker],
+    old_balances,
+    new_balances,
+) -> list[int]:
+    """Per-node weight deltas from vote/balance movement
+    (reference: proto_array_fork_choice.rs compute_deltas)."""
+    deltas = [0] * len(indices)
+    zero = b"\x00" * 32
+    for i, vote in enumerate(votes):
+        if vote.current_root == zero and vote.next_root == zero:
+            continue
+        old_balance = old_balances[i] if i < len(old_balances) else 0
+        new_balance = new_balances[i] if i < len(new_balances) else 0
+        if vote.current_root != vote.next_root or old_balance != new_balance:
+            idx = indices.get(vote.current_root)
+            if idx is not None:
+                deltas[idx] -= int(old_balance)
+            idx = indices.get(vote.next_root)
+            if idx is not None:
+                deltas[idx] += int(new_balance)
+            vote.current_root = vote.next_root
+    return deltas
+
+
+class ProtoArray:
+    def __init__(self, justified_checkpoint, finalized_checkpoint):
+        self.prune_threshold = 256
+        self.justified_checkpoint = justified_checkpoint
+        self.finalized_checkpoint = finalized_checkpoint
+        self.nodes: list[_Node] = []
+        self.indices: dict[bytes, int] = {}
+        self.previous_proposer_boost: tuple[bytes, int] = (b"\x00" * 32, 0)
+
+    # ------------------------------------------------------------- on_block
+    def on_block(self, block: ProtoBlock) -> None:
+        """Register a block (reference: proto_array.rs:293). Idempotent."""
+        if block.root in self.indices:
+            return
+        parent = self.indices.get(block.parent_root) if block.parent_root else None
+        node = _Node(
+            slot=block.slot,
+            root=block.root,
+            state_root=block.state_root,
+            target_root=block.target_root,
+            parent=parent,
+            justified_checkpoint=block.justified_checkpoint,
+            finalized_checkpoint=block.finalized_checkpoint,
+            execution_status=block.execution_status,
+            execution_block_hash=block.execution_block_hash,
+        )
+        index = len(self.nodes)
+        self.indices[block.root] = index
+        self.nodes.append(node)
+        if parent is not None:
+            self._maybe_update_best_child_and_descendant(parent, index)
+
+    # --------------------------------------------------- score propagation
+    def apply_score_changes(
+        self,
+        deltas: list[int],
+        justified_checkpoint,
+        finalized_checkpoint,
+        new_balances,
+        proposer_boost_root: bytes,
+        spec,
+    ) -> None:
+        """Back-propagate deltas child→parent and refresh best links
+        (reference: proto_array.rs:143)."""
+        if len(deltas) != len(self.nodes):
+            raise ProtoArrayError("delta/node length mismatch")
+        self.justified_checkpoint = justified_checkpoint
+        self.finalized_checkpoint = finalized_checkpoint
+
+        # Proposer boost: remove last boost, add new one
+        # (reference: proto_array.rs calculate_committee_fraction).
+        boost_delta_per_root: dict[bytes, int] = {}
+        prev_root, prev_amount = self.previous_proposer_boost
+        if prev_amount:
+            boost_delta_per_root[prev_root] = (
+                boost_delta_per_root.get(prev_root, 0) - prev_amount
+            )
+        new_amount = 0
+        if proposer_boost_root != b"\x00" * 32:
+            new_amount = calculate_committee_fraction(
+                new_balances, spec.PROPOSER_SCORE_BOOST, spec
+            )
+            boost_delta_per_root[proposer_boost_root] = (
+                boost_delta_per_root.get(proposer_boost_root, 0) + new_amount
+            )
+        self.previous_proposer_boost = (proposer_boost_root, new_amount)
+        for root, d in boost_delta_per_root.items():
+            idx = self.indices.get(root)
+            if idx is not None:
+                deltas[idx] += d
+
+        # Child→parent accumulation in one reverse sweep.
+        for index in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[index]
+            delta = deltas[index]
+            if node.execution_status is ExecutionStatus.INVALID:
+                node.weight = 0
+            else:
+                new_weight = node.weight + delta
+                if new_weight < 0:
+                    raise ProtoArrayError(f"negative weight at node {index}")
+                node.weight = new_weight
+            if node.parent is not None:
+                deltas[node.parent] += delta
+
+        for index in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[index]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(node.parent, index)
+
+    # ------------------------------------------------------------ find_head
+    def find_head(self, justified_root: bytes, current_slot: int) -> bytes:
+        """Greedy walk from the justified root (reference: proto_array.rs:607)."""
+        justified_index = self.indices.get(justified_root)
+        if justified_index is None:
+            raise ProtoArrayError(f"unknown justified root {justified_root.hex()}")
+        justified_node = self.nodes[justified_index]
+        best_descendant_index = (
+            justified_node.best_descendant
+            if justified_node.best_descendant is not None
+            else justified_index
+        )
+        best_node = self.nodes[best_descendant_index]
+        if not self._node_is_viable_for_head(best_node, current_slot):
+            raise ProtoArrayError(
+                "best node is not viable for head (justified/finalized or "
+                "invalid-execution filtering)"
+            )
+        return best_node.root
+
+    # ------------------------------------------------------------- pruning
+    def maybe_prune(self, finalized_root: bytes) -> None:
+        """Drop everything before the finalized root once the prefix is
+        long enough to be worth compacting (reference: proto_array.rs)."""
+        finalized_index = self.indices.get(finalized_root)
+        if finalized_index is None:
+            raise ProtoArrayError("unknown finalized root")
+        if finalized_index < self.prune_threshold:
+            return
+        keep = self.nodes[finalized_index:]
+        self.nodes = []
+        self.indices = {}
+        remap: dict[int, int] = {}
+        for old_index, node in enumerate(keep, start=finalized_index):
+            new_index = len(self.nodes)
+            remap[old_index] = new_index
+            self.indices[node.root] = new_index
+            self.nodes.append(node)
+        for node in self.nodes:
+            node.parent = (
+                remap.get(node.parent) if node.parent is not None else None
+            )
+            node.best_child = (
+                remap.get(node.best_child) if node.best_child is not None else None
+            )
+            node.best_descendant = (
+                remap.get(node.best_descendant)
+                if node.best_descendant is not None
+                else None
+            )
+
+    # ------------------------------------------------- execution statuses
+    def process_execution_payload_validation(self, root: bytes) -> None:
+        """Engine said VALID: mark this node and all ancestors valid
+        (reference: proto_array.rs propagate_execution_payload_validation)."""
+        index = self.indices.get(root)
+        while index is not None:
+            node = self.nodes[index]
+            if node.execution_status is ExecutionStatus.INVALID:
+                raise ProtoArrayError("valid payload has invalid ancestor")
+            if node.execution_status is ExecutionStatus.OPTIMISTIC:
+                node.execution_status = ExecutionStatus.VALID
+            index = node.parent
+
+    def process_execution_payload_invalidation(
+        self, head_root: bytes, latest_valid_hash: bytes | None = None
+    ) -> None:
+        """Engine said INVALID for ``head_root``: invalidate it and every
+        descendant; ancestors newer than ``latest_valid_hash`` are also
+        invalidated (reference: proto_array.rs
+        propagate_execution_payload_invalidation)."""
+        index = self.indices.get(head_root)
+        if index is None:
+            raise ProtoArrayError("unknown root for invalidation")
+        # Walk ancestors until the latest valid hash; collect to invalidate.
+        first_invalid = index
+        if latest_valid_hash is not None:
+            cursor: int | None = index
+            while cursor is not None:
+                node = self.nodes[cursor]
+                if node.execution_block_hash == latest_valid_hash or (
+                    node.execution_status
+                    in (ExecutionStatus.VALID, ExecutionStatus.IRRELEVANT)
+                ):
+                    break
+                first_invalid = cursor
+                cursor = node.parent
+        invalid_roots = {self.nodes[first_invalid].root}
+        self.nodes[first_invalid].execution_status = ExecutionStatus.INVALID
+        self.nodes[first_invalid].weight = 0
+        self.nodes[first_invalid].best_child = None
+        self.nodes[first_invalid].best_descendant = None
+        # Descendants (node list is topologically ordered: parents first).
+        for i in range(first_invalid + 1, len(self.nodes)):
+            node = self.nodes[i]
+            parent = self.nodes[node.parent] if node.parent is not None else None
+            if parent is not None and parent.root in invalid_roots:
+                invalid_roots.add(node.root)
+                node.execution_status = ExecutionStatus.INVALID
+                node.weight = 0
+                node.best_child = None
+                node.best_descendant = None
+
+    # ------------------------------------------------------------ internal
+    def _maybe_update_best_child_and_descendant(
+        self, parent_index: int, child_index: int
+    ) -> None:
+        """The four-case best-child update (reference: proto_array.rs
+        maybe_update_best_child_and_descendant)."""
+        child = self.nodes[child_index]
+        parent = self.nodes[parent_index]
+        child_leads = self._node_leads_to_viable_head(child)
+
+        child_best_descendant = (
+            child.best_descendant if child.best_descendant is not None else child_index
+        )
+
+        if parent.best_child is None:
+            if child_leads:
+                parent.best_child = child_index
+                parent.best_descendant = child_best_descendant
+            return
+        if parent.best_child == child_index:
+            if not child_leads:
+                parent.best_child = None
+                parent.best_descendant = None
+            else:
+                parent.best_descendant = child_best_descendant
+            return
+        best = self.nodes[parent.best_child]
+        best_leads = self._node_leads_to_viable_head(best)
+        if child_leads and not best_leads:
+            parent.best_child = child_index
+            parent.best_descendant = child_best_descendant
+        elif child_leads and best_leads:
+            if (child.weight, child.root) > (best.weight, best.root):
+                parent.best_child = child_index
+                parent.best_descendant = child_best_descendant
+            else:
+                parent.best_descendant = (
+                    best.best_descendant
+                    if best.best_descendant is not None
+                    else parent.best_child
+                )
+        elif not child_leads and not best_leads:
+            parent.best_child = None
+            parent.best_descendant = None
+
+    def _node_leads_to_viable_head(self, node: _Node) -> bool:
+        if node.best_descendant is not None:
+            return self._node_is_viable_for_head_relaxed(
+                self.nodes[node.best_descendant]
+            )
+        return self._node_is_viable_for_head_relaxed(node)
+
+    def _node_is_viable_for_head_relaxed(self, node: _Node) -> bool:
+        # Slot-independent viability used during link maintenance.
+        if node.execution_status is ExecutionStatus.INVALID:
+            return False
+        j_ok = (
+            node.justified_checkpoint == self.justified_checkpoint
+            or self.justified_checkpoint[0] == 0
+        )
+        f_ok = (
+            node.finalized_checkpoint == self.finalized_checkpoint
+            or self.finalized_checkpoint[0] == 0
+        )
+        return j_ok and f_ok
+
+    def _node_is_viable_for_head(self, node: _Node, current_slot: int) -> bool:
+        return self._node_is_viable_for_head_relaxed(node)
+
+
+def calculate_committee_fraction(justified_balances, fraction: int, spec) -> int:
+    """committee_weight * fraction / 100 (reference: fork_choice spec's
+    proposer-boost weight: total_active_balance // SLOTS_PER_EPOCH scaled)."""
+    total = int(sum(justified_balances))
+    committee_weight = total // spec.preset.SLOTS_PER_EPOCH
+    return committee_weight * fraction // 100
+
+
+class ProtoArrayForkChoice:
+    """ProtoArray + vote/balance bookkeeping
+    (reference: proto_array_fork_choice.rs:157)."""
+
+    def __init__(
+        self,
+        finalized_block: ProtoBlock,
+        justified_checkpoint,
+        finalized_checkpoint,
+    ):
+        self.proto_array = ProtoArray(justified_checkpoint, finalized_checkpoint)
+        self.votes: list[VoteTracker] = []
+        self.balances: list[int] = []
+        self.proto_array.on_block(finalized_block)
+
+    def process_block(self, block: ProtoBlock) -> None:
+        if block.parent_root is None:
+            raise ProtoArrayError("non-genesis block without parent")
+        self.proto_array.on_block(block)
+
+    def process_attestation(
+        self, validator_index: int, block_root: bytes, target_epoch: int
+    ) -> None:
+        """LMD rule: keep only the newest vote per validator
+        (reference: proto_array_fork_choice.rs:255)."""
+        while validator_index >= len(self.votes):
+            self.votes.append(VoteTracker())
+        vote = self.votes[validator_index]
+        if target_epoch > vote.next_epoch or vote.next_root == b"\x00" * 32:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    def find_head(
+        self,
+        justified_checkpoint,
+        finalized_checkpoint,
+        justified_state_balances,
+        proposer_boost_root: bytes,
+        current_slot: int,
+        spec,
+    ) -> bytes:
+        old_balances = self.balances
+        new_balances = list(justified_state_balances)
+        deltas = compute_deltas(
+            self.proto_array.indices, self.votes, old_balances, new_balances
+        )
+        self.proto_array.apply_score_changes(
+            deltas,
+            justified_checkpoint,
+            finalized_checkpoint,
+            new_balances,
+            proposer_boost_root,
+            spec,
+        )
+        self.balances = new_balances
+        return self.proto_array.find_head(justified_checkpoint[1], current_slot)
+
+    # -- queries -------------------------------------------------------------
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.proto_array.indices
+
+    def get_block(self, root: bytes) -> ProtoBlock | None:
+        idx = self.proto_array.indices.get(root)
+        if idx is None:
+            return None
+        n = self.proto_array.nodes[idx]
+        parent_root = (
+            self.proto_array.nodes[n.parent].root if n.parent is not None else None
+        )
+        return ProtoBlock(
+            slot=n.slot,
+            root=n.root,
+            parent_root=parent_root,
+            state_root=n.state_root,
+            target_root=n.target_root,
+            justified_checkpoint=n.justified_checkpoint,
+            finalized_checkpoint=n.finalized_checkpoint,
+            execution_status=n.execution_status,
+            execution_block_hash=n.execution_block_hash,
+        )
+
+    def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
+        a = self.proto_array.indices.get(ancestor_root)
+        cursor = self.proto_array.indices.get(descendant_root)
+        if a is None or cursor is None:
+            return False
+        while cursor is not None and cursor >= a:
+            if cursor == a:
+                return True
+            cursor = self.proto_array.nodes[cursor].parent
+        return False
+
+    def latest_message(self, validator_index: int) -> tuple[bytes, int] | None:
+        if validator_index < len(self.votes):
+            v = self.votes[validator_index]
+            if v.next_root != b"\x00" * 32:
+                return (v.next_root, v.next_epoch)
+        return None

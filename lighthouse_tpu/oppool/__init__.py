@@ -1,0 +1,4 @@
+"""Operation pool (reference: beacon_node/operation_pool)."""
+
+from .max_cover import maximum_cover  # noqa: F401
+from .pool import OperationPool  # noqa: F401

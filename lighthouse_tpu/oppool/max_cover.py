@@ -1,0 +1,52 @@
+"""Greedy weighted maximum-coverage packing.
+
+Capability mirror of the reference's
+`beacon_node/operation_pool/src/max_cover.rs` (`MaxCover` trait :11,
+`maximum_cover` :48): pick up to ``limit`` items maximizing total covered
+weight, re-scoring every unchosen item after each pick so overlapping
+coverage is never double-counted. The classic greedy gives the (1 - 1/e)
+approximation guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class MaxCoverItem(Protocol):
+    """An item proposing to cover a weighted set of keys."""
+
+    def covering_weights(self) -> dict:  # key -> weight
+        ...
+
+    def update_covered(self, covered_keys: set) -> None:
+        """Remove already-covered keys from this item's proposal."""
+        ...
+
+
+def maximum_cover(items: list, limit: int) -> list:
+    """Greedy max coverage (reference: max_cover.rs:48).
+
+    Items must expose ``covering_weights()`` / ``update_covered(keys)``;
+    they are mutated (their coverage shrinks as keys get covered) and the
+    chosen items are returned in pick order.
+    """
+    remaining = [it for it in items if it.covering_weights()]
+    chosen: list = []
+    while remaining and len(chosen) < limit:
+        best_idx = -1
+        best_score = 0
+        for i, item in enumerate(remaining):
+            score = sum(item.covering_weights().values())
+            if score > best_score:
+                best_score = score
+                best_idx = i
+        if best_idx < 0:
+            break
+        winner = remaining.pop(best_idx)
+        chosen.append(winner)
+        covered = set(winner.covering_weights().keys())
+        for item in remaining:
+            item.update_covered(covered)
+        remaining = [it for it in remaining if it.covering_weights()]
+    return chosen

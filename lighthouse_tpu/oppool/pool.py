@@ -1,0 +1,343 @@
+"""Operation pool: attestations, slashings, exits, sync contributions.
+
+Capability mirror of the reference's `beacon_node/operation_pool`:
+attestations keyed by data root with disjoint-bitfield aggregation,
+block packing via greedy weighted max-cover over *fresh* attesters
+(attestation_storage.rs + attestation.rs AttMaxCover), attester-slashing
+max-cover over slashable indices, proposer-slashing / voluntary-exit dedup
+maps gated on `SigVerifiedOp.is_valid_at`, and a best-per-subcommittee
+sync-contribution store producing the block's `SyncAggregate`
+(sync_aggregate.rs). `prune(state)` drops everything no longer includable.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..consensus import helpers as h
+from ..consensus.committee_cache import CommitteeCache
+from ..consensus.config import (
+    ChainSpec,
+    TIMELY_TARGET_FLAG_INDEX,
+)
+from ..consensus.transition.block import has_flag
+from ..consensus.types import spec_types, state_fork_name
+from ..consensus.verify_operation import SigVerifiedOp, slashable_indices
+from ..crypto.bls.api import AggregateSignature
+from .max_cover import maximum_cover
+
+
+class _AttestationEntry:
+    """One (data, aggregation) under a data root; bits are kept maximal by
+    aggregating every disjoint insertion (reference: attestation_storage.rs)."""
+
+    __slots__ = ("data", "bits", "signature")
+
+    def __init__(self, data, bits, signature: AggregateSignature):
+        self.data = data
+        self.bits = list(bits)
+        self.signature = signature
+
+
+class _AttCover:
+    """Max-cover item: covers validator indices with their fresh weight
+    (reference: attestation.rs AttMaxCover)."""
+
+    def __init__(self, entry, weights: dict[int, int]):
+        self.entry = entry
+        self._weights = dict(weights)
+
+    def covering_weights(self) -> dict:
+        return self._weights
+
+    def update_covered(self, covered: set) -> None:
+        for k in covered:
+            self._weights.pop(k, None)
+
+
+class _SlashingCover:
+    def __init__(self, slashing, weights: dict[int, int]):
+        self.slashing = slashing
+        self._weights = dict(weights)
+
+    def covering_weights(self) -> dict:
+        return self._weights
+
+    def update_covered(self, covered: set) -> None:
+        for k in covered:
+            self._weights.pop(k, None)
+
+
+class OperationPool:
+    def __init__(self, spec: ChainSpec):
+        self.spec = spec
+        # data_root -> list[_AttestationEntry] (disjoint aggregations)
+        self.attestations: dict[bytes, list[_AttestationEntry]] = defaultdict(list)
+        # data_root -> AttestationData (for reconstruction)
+        self._att_data: dict[bytes, object] = {}
+        self.proposer_slashings: dict[int, SigVerifiedOp] = {}
+        self.attester_slashings: list[SigVerifiedOp] = []
+        self.voluntary_exits: dict[int, SigVerifiedOp] = {}
+        # (slot, block_root, subcommittee) -> best contribution
+        self.sync_contributions: dict[tuple, object] = {}
+
+    # ----------------------------------------------------------- attestations
+    def insert_attestation(self, attestation) -> None:
+        """Aggregate ``attestation`` into the pool (signature assumed
+        verified by the caller — gossip/chain layer). Structurally
+        inconsistent data (slot outside its claimed target epoch) is
+        rejected here so one malformed gossip message can never poison
+        block packing."""
+        data = attestation.data
+        p = self.spec.preset
+        if int(data.slot) // p.SLOTS_PER_EPOCH != int(data.target.epoch):
+            raise ValueError("attestation slot not in target epoch")
+        data_root = attestation.data.hash_tree_root()
+        self._att_data[data_root] = attestation.data
+        bits = list(attestation.aggregation_bits)
+        sig = AggregateSignature.from_bytes(bytes(attestation.signature))
+        entries = self.attestations[data_root]
+        for entry in entries:
+            if len(entry.bits) != len(bits):
+                continue
+            overlap = any(a and b for a, b in zip(entry.bits, bits))
+            new_info = any(b and not a for a, b in zip(entry.bits, bits))
+            if not new_info:
+                return  # subset of an existing aggregation
+            if not overlap:
+                entry.bits = [a or b for a, b in zip(entry.bits, bits)]
+                entry.signature.add_assign_aggregate(sig)
+                return
+        entries.append(_AttestationEntry(attestation.data, bits, sig))
+
+    def num_attestations(self) -> int:
+        return sum(len(v) for v in self.attestations.values())
+
+    def get_attestations(self, state, caches: dict | None = None) -> list:
+        """Pack up to MAX_ATTESTATIONS via max-cover over fresh attesters
+        (reference: operation_pool/src/lib.rs get_attestations)."""
+        spec = self.spec
+        p = spec.preset
+        t = spec_types(p)
+        caches = caches if caches is not None else {}
+        current = h.get_current_epoch(state, spec)
+        previous = h.get_previous_epoch(state, spec)
+
+        covers: list[_AttCover] = []
+        for data_root, entries in self.attestations.items():
+            data = self._att_data[data_root]
+            epoch = int(data.target.epoch)
+            if epoch not in (previous, current):
+                continue
+            # inclusion window
+            if not (
+                int(data.slot) + p.MIN_ATTESTATION_INCLUSION_DELAY
+                <= int(state.slot)
+                <= int(data.slot) + p.SLOTS_PER_EPOCH
+            ):
+                continue
+            # source must match the state's justified checkpoint
+            justified = (
+                state.current_justified_checkpoint
+                if epoch == current
+                else state.previous_justified_checkpoint
+            )
+            if data.source != justified:
+                continue
+            if epoch not in caches:
+                caches[epoch] = CommitteeCache.initialized(state, epoch, spec)
+            cache = caches[epoch]
+            if int(data.index) >= cache.committees_per_slot:
+                continue
+            committee = cache.get_beacon_committee(int(data.slot), int(data.index))
+            for entry in entries:
+                if len(entry.bits) != len(committee):
+                    continue
+                weights = self._fresh_weights(
+                    state, data, committee, entry.bits, epoch, current, spec
+                )
+                if weights:
+                    covers.append(_AttCover(entry, weights))
+
+        chosen = maximum_cover(covers, p.MAX_ATTESTATIONS)
+        out = []
+        for c in chosen:
+            out.append(
+                t.Attestation(
+                    aggregation_bits=c.entry.bits,
+                    data=c.entry.data,
+                    signature=c.entry.signature.to_bytes(),
+                )
+            )
+        return out
+
+    def _fresh_weights(
+        self, state, data, committee, bits, epoch, current, spec
+    ) -> dict[int, int]:
+        """validator -> weight for attesters not already credited in the
+        state (the reference's fresh_validators_rewards)."""
+        weights: dict[int, int] = {}
+        altair = state_fork_name(state) != "phase0"
+        if altair:
+            participation = (
+                state.current_epoch_participation
+                if epoch == current
+                else state.previous_epoch_participation
+            )
+        for v, bit in zip(committee, bits):
+            if not bit:
+                continue
+            v = int(v)
+            if altair and has_flag(int(participation[v]), TIMELY_TARGET_FLAG_INDEX):
+                continue  # already credited this epoch
+            weights[v] = int(state.validators[v].effective_balance)
+        return weights
+
+    # -------------------------------------------------------------- slashings
+    def insert_proposer_slashing(self, op: SigVerifiedOp) -> None:
+        index = int(op.operation.signed_header_1.message.proposer_index)
+        self.proposer_slashings[index] = op
+
+    def insert_attester_slashing(self, op: SigVerifiedOp) -> None:
+        self.attester_slashings.append(op)
+
+    def get_slashings(self, state, caches=None) -> tuple[list, list]:
+        """(proposer_slashings, attester_slashings) for a block; attester
+        slashings packed by max-cover over to-be-slashed indices
+        (reference: lib.rs get_slashings)."""
+        spec = self.spec
+        p = spec.preset
+        epoch = h.get_current_epoch(state, spec)
+        proposer = []
+        covered_proposers = set()
+        for index, op in self.proposer_slashings.items():
+            if len(proposer) >= p.MAX_PROPOSER_SLASHINGS:
+                break
+            if not op.is_valid_at(state, spec):
+                continue
+            v = state.validators[index]
+            if h.is_slashable_validator(v, epoch):
+                proposer.append(op.operation)
+                covered_proposers.add(index)
+
+        covers = []
+        for op in self.attester_slashings:
+            if not op.is_valid_at(state, spec):
+                continue
+            idxs = slashable_indices(state, op.operation, spec)
+            weights = {
+                i: int(state.validators[i].effective_balance)
+                for i in idxs
+                if i not in covered_proposers
+            }
+            if weights:
+                covers.append(_SlashingCover(op.operation, weights))
+        chosen = maximum_cover(covers, p.MAX_ATTESTER_SLASHINGS)
+        return proposer, [c.slashing for c in chosen]
+
+    # ------------------------------------------------------------------ exits
+    def insert_voluntary_exit(self, op: SigVerifiedOp) -> None:
+        index = int(op.operation.message.validator_index)
+        self.voluntary_exits.setdefault(index, op)
+
+    def get_voluntary_exits(self, state) -> list:
+        from ..consensus.config import FAR_FUTURE_EPOCH
+
+        spec = self.spec
+        out = []
+        for index, op in self.voluntary_exits.items():
+            if len(out) >= spec.preset.MAX_VOLUNTARY_EXITS:
+                break
+            if not op.is_valid_at(state, spec):
+                continue
+            v = state.validators[index]
+            if v.exit_epoch == FAR_FUTURE_EPOCH:
+                out.append(op.operation)
+        return out
+
+    # ------------------------------------------------------ sync contributions
+    def insert_sync_contribution(self, contribution) -> None:
+        """Keep the best (most participants) contribution per
+        (slot, block_root, subcommittee) (reference: sync_aggregate.rs)."""
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            int(contribution.subcommittee_index),
+        )
+        existing = self.sync_contributions.get(key)
+        if existing is None or sum(contribution.aggregation_bits) > sum(
+            existing.aggregation_bits
+        ):
+            self.sync_contributions[key] = contribution
+
+    def get_sync_aggregate(self, slot: int, beacon_block_root: bytes):
+        """Merge stored subcommittee contributions into one SyncAggregate."""
+        spec = self.spec
+        p = spec.preset
+        t = spec_types(p)
+        from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        bits = [False] * p.SYNC_COMMITTEE_SIZE
+        agg = AggregateSignature.infinity()
+        found = False
+        for sub in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            c = self.sync_contributions.get((slot, bytes(beacon_block_root), sub))
+            if c is None:
+                continue
+            found = True
+            for i, b in enumerate(c.aggregation_bits):
+                if b:
+                    bits[sub * sub_size + i] = True
+            agg.add_assign_aggregate(
+                AggregateSignature.from_bytes(bytes(c.signature))
+            )
+        if not found:
+            return t.SyncAggregate(
+                sync_committee_bits=[False] * p.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=b"\xc0" + bytes(95),
+            )
+        return t.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=agg.to_bytes(),
+        )
+
+    # ------------------------------------------------------------------ prune
+    def prune(self, state) -> None:
+        """Drop operations that can never be included again
+        (reference: lib.rs prune_all)."""
+        spec = self.spec
+        current = h.get_current_epoch(state, spec)
+        previous = h.get_previous_epoch(state, spec)
+        keep: dict[bytes, list] = defaultdict(list)
+        for data_root, entries in self.attestations.items():
+            data = self._att_data[data_root]
+            if int(data.target.epoch) >= previous:
+                keep[data_root] = entries
+        dropped = set(self.attestations) - set(keep)
+        self.attestations = keep
+        for r in dropped:
+            self._att_data.pop(r, None)
+
+        epoch = current
+        self.proposer_slashings = {
+            i: op
+            for i, op in self.proposer_slashings.items()
+            if h.is_slashable_validator(state.validators[i], epoch)
+        }
+        self.attester_slashings = [
+            op
+            for op in self.attester_slashings
+            if slashable_indices(state, op.operation, spec)
+        ]
+        from ..consensus.config import FAR_FUTURE_EPOCH
+
+        self.voluntary_exits = {
+            i: op
+            for i, op in self.voluntary_exits.items()
+            if state.validators[i].exit_epoch == FAR_FUTURE_EPOCH
+        }
+        min_slot = int(state.slot) - 1
+        self.sync_contributions = {
+            k: v for k, v in self.sync_contributions.items() if k[0] >= min_slot
+        }

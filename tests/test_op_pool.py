@@ -1,0 +1,232 @@
+"""Operation pool packing tests (reference: beacon_node/operation_pool tests
++ max_cover.rs unit tests)."""
+
+import pytest
+
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus.committee_cache import CommitteeCache
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.genesis import interop_genesis_state, interop_keypairs
+from lighthouse_tpu.consensus.transition.slot import process_slots
+from lighthouse_tpu.consensus.types import AttestationData, Checkpoint, spec_types
+from lighthouse_tpu.consensus.verify_operation import SigVerifiedOp
+from lighthouse_tpu.oppool import OperationPool, maximum_cover
+
+INFINITY_SIG = b"\xc0" + bytes(95)
+
+
+class Item:
+    def __init__(self, weights):
+        self.w = dict(weights)
+
+    def covering_weights(self):
+        return self.w
+
+    def update_covered(self, covered):
+        for k in covered:
+            self.w.pop(k, None)
+
+
+def test_maximum_cover_greedy():
+    items = [
+        Item({1: 1, 2: 1, 3: 1}),
+        Item({3: 1, 4: 1}),
+        Item({1: 1, 2: 1}),
+        Item({5: 10}),
+    ]
+    chosen = maximum_cover(items, 2)
+    # first pick: {5:10}; second: {1,2,3}
+    assert sorted(sum(c.covering_weights().values()) for c in chosen) == [3, 10]
+
+
+def test_maximum_cover_no_double_count():
+    a = Item({1: 5, 2: 5})
+    b = Item({1: 5, 2: 5, 3: 1})
+    chosen = maximum_cover([a, b], 2)
+    # b wins first (11); a then covers nothing new -> only b chosen
+    assert chosen == [b]
+
+
+def test_maximum_cover_limit():
+    items = [Item({i: 1}) for i in range(10)]
+    assert len(maximum_cover(items, 3)) == 3
+
+
+# ------------------------------------------------------------- pool with state
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def state(spec):
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        s = interop_genesis_state(
+            interop_keypairs(16), 1_600_000_000, spec, sign_deposits=False
+        )
+        return process_slots(s, 2, spec)
+    finally:
+        backends._default = prev
+
+
+def make_attestation(state, spec, slot=1, index=0, bits=None):
+    t = spec_types(spec.preset)
+    cache = CommitteeCache.initialized(state, 0, spec)
+    committee = cache.get_beacon_committee(slot, index)
+    if bits is None:
+        bits = [True] * len(committee)
+    data = AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=b"\x22" * 32,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=0, root=h.get_block_root(state, 0, spec)),
+    )
+    return t.Attestation(
+        aggregation_bits=bits, data=data, signature=INFINITY_SIG
+    ), committee
+
+
+def test_insert_and_pack_attestation(state, spec):
+    pool = OperationPool(spec)
+    att, committee = make_attestation(state, spec)
+    pool.insert_attestation(att)
+    assert pool.num_attestations() == 1
+    packed = pool.get_attestations(state)
+    assert len(packed) == 1
+    assert list(packed[0].aggregation_bits) == list(att.aggregation_bits)
+
+
+def test_disjoint_aggregation(state, spec):
+    pool = OperationPool(spec)
+    att1, committee = make_attestation(state, spec)
+    n = len(committee)
+    assert n >= 2
+    bits_a = [i == 0 for i in range(n)]
+    bits_b = [i == 1 for i in range(n)]
+    a, _ = make_attestation(state, spec, bits=bits_a)
+    b, _ = make_attestation(state, spec, bits=bits_b)
+    pool.insert_attestation(a)
+    pool.insert_attestation(b)
+    # disjoint -> aggregated into one entry
+    assert pool.num_attestations() == 1
+    packed = pool.get_attestations(state)
+    assert len(packed) == 1
+    assert sum(packed[0].aggregation_bits) == 2
+
+
+def test_subset_attestation_ignored(state, spec):
+    pool = OperationPool(spec)
+    att, committee = make_attestation(state, spec)
+    pool.insert_attestation(att)
+    sub, _ = make_attestation(
+        state, spec, bits=[i == 0 for i in range(len(committee))]
+    )
+    pool.insert_attestation(sub)
+    assert pool.num_attestations() == 1
+
+
+def test_inconsistent_slot_epoch_rejected_at_insert(state, spec):
+    """slot outside the claimed target epoch must be rejected at insert so
+    it can never crash block packing (regression)."""
+    att, _ = make_attestation(state, spec)
+    att.data.slot = spec.preset.SLOTS_PER_EPOCH + 2  # epoch 1, target epoch 0
+    pool = OperationPool(spec)
+    with pytest.raises(ValueError):
+        pool.insert_attestation(att)
+    assert pool.get_attestations(state) == []
+
+
+def test_wrong_source_not_packed(state, spec):
+    pool = OperationPool(spec)
+    att, _ = make_attestation(state, spec)
+    att.data.source = Checkpoint(epoch=5, root=b"\x33" * 32)
+    pool.insert_attestation(att)
+    assert pool.get_attestations(state) == []
+
+
+def test_prune_drops_stale(state, spec):
+    pool = OperationPool(spec)
+    att, _ = make_attestation(state, spec)
+    pool.insert_attestation(att)
+    pool.prune(state)
+    assert pool.num_attestations() == 1  # target epoch 0 >= previous epoch
+    # move far into the future: epoch 0 attestations become stale
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        future = process_slots(state.copy(), 4 * spec.preset.SLOTS_PER_EPOCH, spec)
+    finally:
+        backends._default = prev
+    pool.prune(future)
+    assert pool.num_attestations() == 0
+
+
+def test_exits_dedup_and_gating(state, spec):
+    from lighthouse_tpu.consensus.types import SignedVoluntaryExit, VoluntaryExit
+
+    pool = OperationPool(spec)
+    ex = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=0, validator_index=3), signature=INFINITY_SIG
+    )
+    op = SigVerifiedOp.new(ex, state, [0])
+    pool.insert_voluntary_exit(op)
+    pool.insert_voluntary_exit(op)  # dedup by validator
+    assert len(pool.voluntary_exits) == 1
+    got = pool.get_voluntary_exits(state)
+    assert got == [ex]
+    # after the validator exits, the op is no longer offered
+    exited = state.copy()
+    exited.validators[3].exit_epoch = 1
+    assert pool.get_voluntary_exits(exited) == []
+    pool.prune(exited)
+    assert len(pool.voluntary_exits) == 0
+
+
+def test_sync_contribution_aggregate(state, spec):
+    t = spec_types(spec.preset)
+    from lighthouse_tpu.consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+    pool = OperationPool(spec)
+    sub_size = spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    root = b"\x55" * 32
+    c0 = t.SyncCommitteeContribution(
+        slot=5,
+        beacon_block_root=root,
+        subcommittee_index=0,
+        aggregation_bits=[True] + [False] * (sub_size - 1),
+        signature=INFINITY_SIG,
+    )
+    c0_better = t.SyncCommitteeContribution(
+        slot=5,
+        beacon_block_root=root,
+        subcommittee_index=0,
+        aggregation_bits=[True, True] + [False] * (sub_size - 2),
+        signature=INFINITY_SIG,
+    )
+    c1 = t.SyncCommitteeContribution(
+        slot=5,
+        beacon_block_root=root,
+        subcommittee_index=1,
+        aggregation_bits=[True] * sub_size,
+        signature=INFINITY_SIG,
+    )
+    pool.insert_sync_contribution(c0)
+    pool.insert_sync_contribution(c0_better)  # replaces c0
+    pool.insert_sync_contribution(c1)
+    agg = pool.get_sync_aggregate(5, root)
+    bits = list(agg.sync_committee_bits)
+    assert sum(bits[:sub_size]) == 2
+    assert sum(bits[sub_size : 2 * sub_size]) == sub_size
+    # unknown root -> empty aggregate with infinity signature
+    empty = pool.get_sync_aggregate(5, b"\x66" * 32)
+    assert sum(empty.sync_committee_bits) == 0
+    assert bytes(empty.sync_committee_signature) == INFINITY_SIG
