@@ -270,10 +270,20 @@ class ProtoArray:
         index = self.indices.get(head_root)
         if index is None:
             raise ProtoArrayError("unknown root for invalidation")
+        start = self.nodes[index]
+        if start.execution_block_hash == latest_valid_hash or start.execution_status in (
+            ExecutionStatus.VALID,
+            ExecutionStatus.IRRELEVANT,
+        ):
+            # The named block is itself the latest valid one (or not an
+            # execution block): nothing to invalidate at or above it.
+            return
         # Walk ancestors until the latest valid hash; collect to invalidate.
+        # Break conditions are checked BEFORE claiming a node, so the
+        # latest-valid block is never flipped to INVALID.
         first_invalid = index
         if latest_valid_hash is not None:
-            cursor: int | None = index
+            cursor: int | None = self.nodes[index].parent
             while cursor is not None:
                 node = self.nodes[cursor]
                 if node.execution_block_hash == latest_valid_hash or (

@@ -1,0 +1,106 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The reference links LevelDB/MDBX/SQLite C libraries (SURVEY §2.6); here the
+storage engine is our own C++ `lhkv` log-structured store, compiled from
+`kvstore.cpp` into a shared library at first use (cached next to the
+source, keyed by source hash) and bound via ctypes — pybind11 is not in
+this image.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build_lib() -> str:
+    src = os.path.join(_DIR, "kvstore.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_DIR, f"liblhkv-{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + ".tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+    os.replace(tmp, out)
+    # Drop stale builds.
+    for name in os.listdir(_DIR):
+        if name.startswith("liblhkv-") and name.endswith(".so") and name != os.path.basename(out):
+            try:
+                os.unlink(os.path.join(_DIR, name))
+            except OSError:
+                pass
+    return out
+
+
+def load_lhkv() -> ctypes.CDLL:
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build_lib())
+            lib.lhkv_open.restype = ctypes.c_void_p
+            lib.lhkv_open.argtypes = [ctypes.c_char_p]
+            lib.lhkv_close.argtypes = [ctypes.c_void_p]
+            lib.lhkv_put.restype = ctypes.c_int
+            lib.lhkv_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhkv_delete.restype = ctypes.c_int
+            lib.lhkv_delete.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhkv_get.restype = ctypes.c_int
+            lib.lhkv_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            lib.lhkv_exists.restype = ctypes.c_int
+            lib.lhkv_exists.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhkv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            lib.lhkv_batch.restype = ctypes.c_int
+            lib.lhkv_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhkv_sync.restype = ctypes.c_int
+            lib.lhkv_sync.argtypes = [ctypes.c_void_p]
+            lib.lhkv_count.restype = ctypes.c_size_t
+            lib.lhkv_count.argtypes = [ctypes.c_void_p]
+            lib.lhkv_dead_bytes.restype = ctypes.c_uint64
+            lib.lhkv_dead_bytes.argtypes = [ctypes.c_void_p]
+            lib.lhkv_compact.restype = ctypes.c_int
+            lib.lhkv_compact.argtypes = [ctypes.c_void_p]
+            lib.lhkv_iter.restype = ctypes.c_void_p
+            lib.lhkv_iter.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhkv_iter_next.restype = ctypes.c_int
+            lib.lhkv_iter_next.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            lib.lhkv_iter_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+    return _LIB

@@ -1,0 +1,423 @@
+// lhkv — log-structured key-value engine with ordered iteration.
+//
+// Native-store equivalent of the reference's LevelDB dependency
+// (beacon_node/store/Cargo.toml:13; hot_cold_store.rs uses it through the
+// ItemStore trait): the hot DB, the freezer DB, and the slasher DB all sit
+// on this engine. Design: one append-only log file per database, an
+// in-memory ordered index (std::map key -> (offset, len)) rebuilt by
+// scanning the log on open, atomic multi-op batches via a single buffered
+// append + index swap, and copy-compaction that rewrites only live records.
+// CRC32-checked records; a torn tail at the end of the log (crash mid-
+// append) is detected and truncated on open.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4C484B56;  // "LHKV"
+constexpr uint8_t kOpPut = 1;
+constexpr uint8_t kOpDelete = 2;
+
+// CRC32 (polynomial 0xEDB88320), table-driven.
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed = 0) {
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Record layout: [u8 op][u32 klen][u32 vlen][key][val][u32 crc]
+// crc covers op..val.
+constexpr size_t kHeaderLen = 1 + 4 + 4;
+
+struct ValueLoc {
+  uint64_t offset;  // offset of the value bytes within the log
+  uint32_t len;
+};
+
+struct Db {
+  std::string path;
+  int fd = -1;
+  uint64_t log_end = 0;
+  std::map<std::string, ValueLoc> index;
+  std::mutex mu;
+  uint64_t dead_bytes = 0;
+  int open_iters = 0;
+
+  ~Db() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+bool append_record(Db* db, uint8_t op, const std::string& key,
+                   const uint8_t* val, uint32_t vlen, std::string* buf) {
+  uint32_t klen = (uint32_t)key.size();
+  size_t start = buf->size();
+  buf->push_back((char)op);
+  buf->append((const char*)&klen, 4);
+  buf->append((const char*)&vlen, 4);
+  buf->append(key);
+  if (vlen) buf->append((const char*)val, vlen);
+  uint32_t crc = crc32((const uint8_t*)buf->data() + start, buf->size() - start);
+  buf->append((const char*)&crc, 4);
+  return true;
+}
+
+// Returns bytes consumed, 0 on clean EOF, -1 on torn/corrupt record.
+ssize_t scan_record(const uint8_t* data, size_t avail, uint8_t* op,
+                    std::string* key, uint64_t* val_off_in_rec, uint32_t* vlen) {
+  if (avail == 0) return 0;
+  if (avail < kHeaderLen) return -1;
+  *op = data[0];
+  uint32_t klen, vl;
+  memcpy(&klen, data + 1, 4);
+  memcpy(&vl, data + 5, 4);
+  size_t total = kHeaderLen + klen + vl + 4;
+  if (avail < total) return -1;
+  uint32_t crc_stored;
+  memcpy(&crc_stored, data + kHeaderLen + klen + vl, 4);
+  if (crc32(data, kHeaderLen + klen + vl) != crc_stored) return -1;
+  key->assign((const char*)data + kHeaderLen, klen);
+  *val_off_in_rec = kHeaderLen + klen;
+  *vlen = vl;
+  return (ssize_t)total;
+}
+
+bool load_log(Db* db) {
+  struct stat st;
+  if (fstat(db->fd, &st) != 0) return false;
+  size_t size = (size_t)st.st_size;
+  std::vector<uint8_t> data(size);
+  size_t got = 0;
+  while (got < size) {
+    ssize_t n = pread(db->fd, data.data() + got, size - got, (off_t)got);
+    if (n <= 0) return false;
+    got += (size_t)n;
+  }
+  size_t pos = 0;
+  if (size >= 4) {
+    uint32_t magic;
+    memcpy(&magic, data.data(), 4);
+    if (magic != kMagic) return false;
+    pos = 4;
+  } else if (size > 0) {
+    return false;
+  } else {
+    // fresh file: write magic
+    uint32_t magic = kMagic;
+    if (pwrite(db->fd, &magic, 4, 0) != 4) return false;
+    db->log_end = 4;
+    return true;
+  }
+  while (pos < size) {
+    uint8_t op;
+    std::string key;
+    uint64_t voff;
+    uint32_t vlen;
+    ssize_t consumed = scan_record(data.data() + pos, size - pos, &op, &key, &voff, &vlen);
+    if (consumed <= 0) {
+      // torn tail: truncate here
+      if (ftruncate(db->fd, (off_t)pos) != 0) return false;
+      break;
+    }
+    if (op == kOpPut) {
+      auto it = db->index.find(key);
+      if (it != db->index.end()) db->dead_bytes += it->second.len + kHeaderLen + key.size() + 4;
+      db->index[key] = ValueLoc{pos + voff, vlen};
+    } else if (op == kOpDelete) {
+      auto it = db->index.find(key);
+      if (it != db->index.end()) {
+        db->dead_bytes += it->second.len + kHeaderLen + key.size() + 4;
+        db->index.erase(it);
+      }
+    }
+    pos += (size_t)consumed;
+  }
+  db->log_end = pos;
+  return true;
+}
+
+struct Iter {
+  std::vector<std::pair<std::string, ValueLoc>> items;  // snapshot
+  size_t pos = 0;
+  Db* db;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lhkv_open(const char* path) {
+  Db* db = new Db();
+  db->path = path;
+  db->fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (db->fd < 0 || !load_log(db)) {
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+void lhkv_close(void* h) { delete (Db*)h; }
+
+// ops buffer: repeated [u8 op][u32 klen][u32 vlen][key][val]
+int lhkv_batch(void* h, const uint8_t* ops, size_t len) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  std::string buf;
+  struct Pending {
+    uint8_t op;
+    std::string key;
+    uint64_t val_off_in_buf;
+    uint32_t vlen;
+  };
+  std::vector<Pending> pending;
+  size_t pos = 0;
+  while (pos < len) {
+    if (len - pos < kHeaderLen) return -1;
+    uint8_t op = ops[pos];
+    uint32_t klen, vlen;
+    memcpy(&klen, ops + pos + 1, 4);
+    memcpy(&vlen, ops + pos + 5, 4);
+    if (len - pos < kHeaderLen + klen + vlen) return -1;
+    std::string key((const char*)ops + pos + kHeaderLen, klen);
+    size_t rec_start = buf.size();
+    append_record(db, op, key, ops + pos + kHeaderLen + klen, vlen, &buf);
+    pending.push_back(Pending{op, std::move(key),
+                              rec_start + kHeaderLen + klen, vlen});
+    pos += kHeaderLen + klen + vlen;
+  }
+  // single append
+  uint64_t base = db->log_end;
+  size_t written = 0;
+  while (written < buf.size()) {
+    ssize_t n = pwrite(db->fd, buf.data() + written, buf.size() - written,
+                       (off_t)(base + written));
+    if (n <= 0) return -2;
+    written += (size_t)n;
+  }
+  db->log_end = base + buf.size();
+  for (auto& p : pending) {
+    if (p.op == kOpPut) {
+      auto it = db->index.find(p.key);
+      if (it != db->index.end())
+        db->dead_bytes += it->second.len + kHeaderLen + p.key.size() + 4;
+      db->index[p.key] = ValueLoc{base + p.val_off_in_buf, p.vlen};
+    } else {
+      auto it = db->index.find(p.key);
+      if (it != db->index.end()) {
+        db->dead_bytes += it->second.len + kHeaderLen + p.key.size() + 4;
+        db->index.erase(it);
+      }
+    }
+  }
+  return 0;
+}
+
+int lhkv_put(void* h, const uint8_t* key, size_t klen, const uint8_t* val,
+             size_t vlen) {
+  std::string ops;
+  uint32_t kl = (uint32_t)klen, vl = (uint32_t)vlen;
+  ops.push_back((char)kOpPut);
+  ops.append((const char*)&kl, 4);
+  ops.append((const char*)&vl, 4);
+  ops.append((const char*)key, klen);
+  ops.append((const char*)val, vlen);
+  return lhkv_batch(h, (const uint8_t*)ops.data(), ops.size());
+}
+
+int lhkv_delete(void* h, const uint8_t* key, size_t klen) {
+  std::string ops;
+  uint32_t kl = (uint32_t)klen, vl = 0;
+  ops.push_back((char)kOpDelete);
+  ops.append((const char*)&kl, 4);
+  ops.append((const char*)&vl, 4);
+  ops.append((const char*)key, klen);
+  return lhkv_batch(h, (const uint8_t*)ops.data(), ops.size());
+}
+
+// Returns 0 + malloc'd *val on hit, 1 on miss, <0 on error.
+int lhkv_get(void* h, const uint8_t* key, size_t klen, uint8_t** val,
+             size_t* vlen) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  auto it = db->index.find(std::string((const char*)key, klen));
+  if (it == db->index.end()) return 1;
+  uint8_t* out = (uint8_t*)malloc(it->second.len ? it->second.len : 1);
+  size_t got = 0;
+  while (got < it->second.len) {
+    ssize_t n = pread(db->fd, out + got, it->second.len - got,
+                      (off_t)(it->second.offset + got));
+    if (n <= 0) {
+      free(out);
+      return -1;
+    }
+    got += (size_t)n;
+  }
+  *val = out;
+  *vlen = it->second.len;
+  return 0;
+}
+
+int lhkv_exists(void* h, const uint8_t* key, size_t klen) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  return db->index.count(std::string((const char*)key, klen)) ? 1 : 0;
+}
+
+void lhkv_free(uint8_t* p) { free(p); }
+
+int lhkv_sync(void* h) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  return fsync(db->fd) == 0 ? 0 : -1;
+}
+
+size_t lhkv_count(void* h) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  return db->index.size();
+}
+
+uint64_t lhkv_dead_bytes(void* h) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  return db->dead_bytes;
+}
+
+// Copy-compaction: rewrite live records to <path>.compact, fsync, rename.
+// Refuses (rc -3) while iterators are open: iterator snapshots hold offsets
+// into the pre-compaction log file and would read garbage from the new one.
+int lhkv_compact(void* h) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  if (db->open_iters > 0) return -3;
+  std::string tmp_path = db->path + ".compact";
+  int tfd = open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (tfd < 0) return -1;
+  uint32_t magic = kMagic;
+  if (pwrite(tfd, &magic, 4, 0) != 4) {
+    close(tfd);
+    return -1;
+  }
+  uint64_t tpos = 4;
+  std::map<std::string, ValueLoc> new_index;
+  std::string buf;
+  for (auto& kv : db->index) {
+    buf.clear();
+    std::vector<uint8_t> val(kv.second.len);
+    size_t got = 0;
+    while (got < kv.second.len) {
+      ssize_t n = pread(db->fd, val.data() + got, kv.second.len - got,
+                        (off_t)(kv.second.offset + got));
+      if (n <= 0) {
+        close(tfd);
+        return -1;
+      }
+      got += (size_t)n;
+    }
+    append_record(db, kOpPut, kv.first, val.data(), kv.second.len, &buf);
+    size_t written = 0;
+    while (written < buf.size()) {
+      ssize_t n = pwrite(tfd, buf.data() + written, buf.size() - written,
+                         (off_t)(tpos + written));
+      if (n <= 0) {
+        close(tfd);
+        return -1;
+      }
+      written += (size_t)n;
+    }
+    new_index[kv.first] =
+        ValueLoc{tpos + kHeaderLen + kv.first.size(), kv.second.len};
+    tpos += buf.size();
+  }
+  if (fsync(tfd) != 0 || rename(tmp_path.c_str(), db->path.c_str()) != 0) {
+    close(tfd);
+    return -1;
+  }
+  close(db->fd);
+  db->fd = tfd;
+  db->index.swap(new_index);
+  db->log_end = tpos;
+  db->dead_bytes = 0;
+  return 0;
+}
+
+// Ordered iteration over keys with a given prefix (snapshot semantics).
+void* lhkv_iter(void* h, const uint8_t* prefix, size_t plen) {
+  Db* db = (Db*)h;
+  std::lock_guard<std::mutex> lock(db->mu);
+  Iter* it = new Iter();
+  it->db = db;
+  db->open_iters++;
+  std::string p((const char*)prefix, plen);
+  auto lo = db->index.lower_bound(p);
+  for (auto cur = lo; cur != db->index.end(); ++cur) {
+    if (cur->first.compare(0, p.size(), p) != 0) break;
+    it->items.push_back(*cur);
+  }
+  return it;
+}
+
+// 0 = item produced; 1 = exhausted.
+int lhkv_iter_next(void* hi, uint8_t** key, size_t* klen, uint8_t** val,
+                   size_t* vlen) {
+  Iter* it = (Iter*)hi;
+  if (it->pos >= it->items.size()) return 1;
+  auto& kv = it->items[it->pos++];
+  Db* db = it->db;
+  std::lock_guard<std::mutex> lock(db->mu);
+  uint8_t* out = (uint8_t*)malloc(kv.second.len ? kv.second.len : 1);
+  size_t got = 0;
+  while (got < kv.second.len) {
+    ssize_t n = pread(db->fd, out + got, kv.second.len - got,
+                      (off_t)(kv.second.offset + got));
+    if (n <= 0) {
+      free(out);
+      return -1;
+    }
+    got += (size_t)n;
+  }
+  uint8_t* k = (uint8_t*)malloc(kv.first.size() ? kv.first.size() : 1);
+  memcpy(k, kv.first.data(), kv.first.size());
+  *key = k;
+  *klen = kv.first.size();
+  *val = out;
+  *vlen = kv.second.len;
+  return 0;
+}
+
+void lhkv_iter_close(void* hi) {
+  Iter* it = (Iter*)hi;
+  {
+    std::lock_guard<std::mutex> lock(it->db->mu);
+    it->db->open_iters--;
+  }
+  delete it;
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+"""Storage layer (reference: beacon_node/store): ItemStore backends over
+the native lhkv engine plus the hot/cold split database."""
+
+from .kv import KVStore, MemoryStore  # noqa: F401
+from .hot_cold import HotColdDB, StoreConfig, StoreError, Split  # noqa: F401

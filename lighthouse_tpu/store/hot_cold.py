@@ -1,0 +1,388 @@
+"""HotColdDB — split hot/freezer store with restore-point reconstruction.
+
+Capability mirror of the reference's `beacon_node/store/src/hot_cold_store.rs:42-62`:
+
+* **hot** half: blocks by root, per-slot *state summaries*
+  (state_root -> {slot, latest_block_root}), and full states at epoch
+  boundaries; non-boundary hot state reads replay blocks from the nearest
+  boundary snapshot (the reference's `get_hot_state` + `BlockReplayer`).
+* **cold** (freezer) half: finalized history as chunked vectors of
+  block/state roots (`chunked_vector.rs`) plus full restore-point states
+  every `slots_per_restore_point` slots (`partial_beacon_state.rs` role);
+  state-at-slot reads replay from the nearest restore point
+  (`hot_cold_store.rs:480`).
+* `migrate(finalized_state)` advances the split, moving finalized history
+  from hot to cold and garbage-collecting hot states
+  (reference: beacon_chain/src/migrate.rs + garbage_collection.rs).
+
+Schema metadata (`metadata.rs` CURRENT_SCHEMA_VERSION) and the split point
+live in the metadata column.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from ..consensus.config import ChainSpec
+from ..consensus.transition.replay import BlockReplayer
+from ..consensus.types import FORK_ORDER, spec_types, state_fork_name
+
+# Columns (reference: store/src/lib.rs DBColumn)
+COL_BLOCK = b"blk"
+COL_STATE = b"ste"  # hot full states (epoch boundaries)
+COL_SUMMARY = b"sum"  # hot per-slot state summaries
+COL_COLD_BLOCK_ROOTS = b"bro"  # chunked block roots by slot
+COL_COLD_STATE_ROOTS = b"sro"
+COL_RESTORE_POINT = b"rpt"
+COL_META = b"met"
+
+KEY_SCHEMA = b"schema"
+KEY_SPLIT = b"split"
+KEY_GENESIS_BLOCK_ROOT = b"genesis_block_root"
+
+CURRENT_SCHEMA_VERSION = 1
+CHUNK_SIZE = 128
+
+
+class StoreError(ValueError):
+    pass
+
+
+@dataclass
+class StoreConfig:
+    """(reference: store/src/config.rs)"""
+
+    slots_per_restore_point: int = 32
+    chunk_size: int = CHUNK_SIZE
+
+
+@dataclass
+class Split:
+    """Hot/cold boundary (reference: hot_cold_store.rs Split)."""
+
+    slot: int = 0
+    state_root: bytes = b"\x00" * 32
+
+
+def _enc_u64(v: int) -> bytes:
+    return struct.pack(">Q", v)
+
+
+class HotColdDB:
+    def __init__(self, store, spec: ChainSpec, config: StoreConfig | None = None):
+        """``store`` is an ItemStore (KVStore or MemoryStore)."""
+        self.db = store
+        self.spec = spec
+        self.config = config or StoreConfig()
+        self.types = spec_types(spec.preset)
+        raw = self.db.get(COL_META, KEY_SCHEMA)
+        if raw is None:
+            self.db.put(COL_META, KEY_SCHEMA, _enc_u64(CURRENT_SCHEMA_VERSION))
+        elif struct.unpack(">Q", raw)[0] != CURRENT_SCHEMA_VERSION:
+            raise StoreError(
+                f"schema version {struct.unpack('>Q', raw)[0]} needs migration"
+            )
+        raw = self.db.get(COL_META, KEY_SPLIT)
+        if raw is None:
+            self.split = Split()
+        else:
+            slot = struct.unpack(">Q", raw[:8])[0]
+            self.split = Split(slot, raw[8:40])
+
+    # ---------------------------------------------------------- serialization
+    def _encode_block(self, signed_block) -> bytes:
+        fork = type(signed_block).fork
+        return bytes([FORK_ORDER.index(fork)]) + signed_block.encode()
+
+    def _decode_block(self, data: bytes):
+        fork = FORK_ORDER[data[0]]
+        return self.types.SIGNED_BLOCK_BY_FORK[fork].decode(data[1:])
+
+    def _encode_state(self, state) -> bytes:
+        fork = state_fork_name(state)
+        return bytes([FORK_ORDER.index(fork)]) + state.encode()
+
+    def _decode_state(self, data: bytes):
+        fork = FORK_ORDER[data[0]]
+        return self.types.STATE_BY_FORK[fork].decode(data[1:])
+
+    # ----------------------------------------------------------------- blocks
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        self.db.put(COL_BLOCK, block_root, self._encode_block(signed_block))
+
+    def get_block(self, block_root: bytes):
+        raw = self.db.get(COL_BLOCK, block_root)
+        return self._decode_block(raw) if raw is not None else None
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.db.exists(COL_BLOCK, block_root)
+
+    # ----------------------------------------------------------------- states
+    def put_state(self, state_root: bytes, state) -> None:
+        """Summary always; full state at epoch boundaries (reference:
+        hot_cold_store.rs store_hot_state)."""
+        ops = [("put", COL_SUMMARY, state_root, self._summary_bytes(state))]
+        if int(state.slot) % self.spec.preset.SLOTS_PER_EPOCH == 0:
+            ops.append(("put", COL_STATE, state_root, self._encode_state(state)))
+        self.db.batch(ops)
+
+    @staticmethod
+    def latest_block_root(state) -> bytes:
+        """Canonical latest block root: a just-applied block's header still
+        has a zeroed state_root which process_slot would fill with this
+        state's root — fill it the same way before hashing (reference:
+        BeaconState::get_latest_block_root)."""
+        header = state.latest_block_header
+        if bytes(header.state_root) == b"\x00" * 32:
+            header = header.copy()
+            header.state_root = state.hash_tree_root()
+        return header.hash_tree_root()
+
+    def _summary_bytes(self, state) -> bytes:
+        """HotStateSummary {slot, latest_block_root, epoch_boundary_state_root}
+        (reference: hot_cold_store.rs HotStateSummary) — the boundary root
+        names the snapshot to replay from."""
+        p = self.spec.preset
+        slot = int(state.slot)
+        boundary_slot = (slot // p.SLOTS_PER_EPOCH) * p.SLOTS_PER_EPOCH
+        if slot == boundary_slot:
+            boundary_root = state.hash_tree_root()
+        else:
+            boundary_root = bytes(
+                state.state_roots[boundary_slot % p.SLOTS_PER_HISTORICAL_ROOT]
+            )
+        return (
+            struct.pack(">Q", slot)
+            + self.latest_block_root(state)
+            + bytes(boundary_root)
+        )
+
+    def _load_summary(self, state_root: bytes) -> tuple[int, bytes, bytes] | None:
+        raw = self.db.get(COL_SUMMARY, state_root)
+        if raw is None:
+            return None
+        return struct.unpack(">Q", raw[:8])[0], raw[8:40], raw[40:72]
+
+    def get_state(self, state_root: bytes, slot: int | None = None):
+        """Load a state by root — hot path; for finalized slots use
+        ``get_cold_state_by_slot`` (reference: get_state)."""
+        raw = self.db.get(COL_STATE, state_root)
+        if raw is not None:
+            return self._decode_state(raw)
+        return self._replay_hot_state(state_root)
+
+    def _replay_hot_state(self, state_root: bytes):
+        """Load the summary's epoch-boundary snapshot and replay blocks up
+        to the summary slot (reference: load_hot_state + BlockReplayer)."""
+        summary = self._load_summary(state_root)
+        if summary is None:
+            return None
+        target_slot, latest_block_root, boundary_root = summary
+        raw = self.db.get(COL_STATE, boundary_root)
+        if raw is None:
+            raise StoreError(
+                f"missing epoch-boundary snapshot {boundary_root.hex()}"
+            )
+        base_state = self._decode_state(raw)
+
+        # Blocks between the snapshot and the target: walk newest-first
+        # until we hit the snapshot's own latest block (empty-slot chains
+        # terminate immediately — both summaries name the same block).
+        base_latest = self.latest_block_root(base_state)
+        blocks = []
+        root = latest_block_root
+        while root != base_latest:
+            block = self.get_block(root)
+            if block is None:
+                raise StoreError("missing block during hot replay")
+            if int(block.message.slot) <= int(base_state.slot):
+                break
+            blocks.append(block)
+            root = bytes(block.message.parent_root)
+        blocks.reverse()
+
+        replayer = BlockReplayer(
+            base_state.copy(), self.spec
+        ).no_signature_verification()
+        return replayer.apply_blocks(blocks, target_slot=target_slot).into_state()
+
+    # ------------------------------------------------------------ cold access
+    def _chunk(self, column: bytes, slot: int) -> bytes | None:
+        return self.db.get(column, _enc_u64(slot // self.config.chunk_size))
+
+    def _cold_root(self, column: bytes, slot: int) -> bytes | None:
+        chunk = self._chunk(column, slot)
+        if chunk is None:
+            return None
+        i = (slot % self.config.chunk_size) * 32
+        root = chunk[i : i + 32]
+        return root if len(root) == 32 and root != b"\x00" * 32 else None
+
+    def cold_block_root_at_slot(self, slot: int) -> bytes | None:
+        return self._cold_root(COL_COLD_BLOCK_ROOTS, slot)
+
+    def cold_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self._cold_root(COL_COLD_STATE_ROOTS, slot)
+
+    def get_cold_state_by_slot(self, slot: int):
+        """Nearest restore point ≤ slot, then replay (reference:
+        hot_cold_store.rs load_cold_state_by_slot)."""
+        srp = self.config.slots_per_restore_point
+        rp_index = slot // srp
+        raw = self.db.get(COL_RESTORE_POINT, _enc_u64(rp_index))
+        if raw is None:
+            return None
+        state = self._decode_state(raw)
+        if int(state.slot) == slot:
+            return state
+        blocks = []
+        prev_root = None
+        for s in range(int(state.slot) + 1, slot + 1):
+            root = self.cold_block_root_at_slot(s)
+            if root is None or root == prev_root:
+                continue
+            prev_root = root
+            blk = self.get_block(root)
+            if blk is not None and int(blk.message.slot) > int(state.slot):
+                blocks.append(blk)
+        roots = []
+        for s in range(int(state.slot), slot + 1):
+            r = self.cold_state_root_at_slot(s)
+            if r is not None:
+                roots.append((s, r))
+        replayer = (
+            BlockReplayer(state.copy(), self.spec)
+            .no_signature_verification()
+            .state_root_iter(roots)
+        )
+        return replayer.apply_blocks(blocks, target_slot=slot).into_state()
+
+    # -------------------------------------------------------------- migration
+    def migrate(self, finalized_state, finalized_block_root: bytes) -> None:
+        """Advance the split to the finalized slot: record cold root
+        vectors + restore points for [old_split, finalized_slot) and delete
+        migrated hot states (reference: migrate.rs run_migration +
+        hot_cold_store.rs migrate_database)."""
+        p = self.spec.preset
+        finalized_slot = int(finalized_state.slot)
+        # Finalized checkpoints are epoch boundaries; a non-aligned split
+        # would delete boundary snapshots that post-split summaries still
+        # replay from, bricking the anchor (checkpoint STATES are always
+        # advanced to the epoch-start slot even when the checkpoint block
+        # is older).
+        if finalized_slot % p.SLOTS_PER_EPOCH != 0:
+            raise StoreError("migration requires an epoch-aligned finalized state")
+        old_split = self.split.slot
+        if finalized_slot <= old_split:
+            return
+        if finalized_slot - old_split > p.SLOTS_PER_HISTORICAL_ROOT:
+            raise StoreError("migration window exceeds historical root vectors")
+
+        srp = self.config.slots_per_restore_point
+        ops = []
+        to_delete: list[bytes] = []
+        # chunk buffers
+        chunks: dict[tuple[bytes, int], bytearray] = {}
+
+        def set_root(column: bytes, slot: int, root: bytes):
+            ck = (column, slot // self.config.chunk_size)
+            if ck not in chunks:
+                existing = self.db.get(column, _enc_u64(ck[1]))
+                buf = bytearray(existing or b"\x00" * (32 * self.config.chunk_size))
+                chunks[ck] = buf
+            i = (slot % self.config.chunk_size) * 32
+            chunks[ck][i : i + 32] = root
+
+        for slot in range(old_split, finalized_slot):
+            block_root = bytes(
+                finalized_state.block_roots[slot % p.SLOTS_PER_HISTORICAL_ROOT]
+            )
+            state_root = bytes(
+                finalized_state.state_roots[slot % p.SLOTS_PER_HISTORICAL_ROOT]
+            )
+            set_root(COL_COLD_BLOCK_ROOTS, slot, block_root)
+            set_root(COL_COLD_STATE_ROOTS, slot, state_root)
+            if slot % srp == 0:
+                state = self.get_state(state_root)
+                if state is None:
+                    raise StoreError(
+                        f"missing hot state {state_root.hex()} for restore point"
+                    )
+                ops.append(
+                    ("put", COL_RESTORE_POINT, _enc_u64(slot // srp),
+                     self._encode_state(state))
+                )
+            to_delete.append(state_root)
+
+        for (column, chunk_index), buf in chunks.items():
+            ops.append(("put", column, _enc_u64(chunk_index), bytes(buf)))
+        finalized_state_root = finalized_state.hash_tree_root()
+        ops.append(
+            ("put", COL_META, KEY_SPLIT,
+             struct.pack(">Q", finalized_slot) + bytes(finalized_state_root))
+        )
+        # Canonical-chain states below the split…
+        for state_root in to_delete:
+            ops.append(("del", COL_STATE, state_root))
+            ops.append(("del", COL_SUMMARY, state_root))
+        # …plus abandoned-fork states: any remaining summary below the new
+        # split is unreachable history (reference: garbage_collection.rs
+        # deletes abandoned states at migration).
+        deleted = set(to_delete)
+        for key, raw in list(self.db.iter_column(COL_SUMMARY)):
+            if key in deleted:
+                continue
+            slot = struct.unpack(">Q", raw[:8])[0]
+            if slot < finalized_slot:
+                ops.append(("del", COL_STATE, key))
+                ops.append(("del", COL_SUMMARY, key))
+        self.db.batch(ops)
+        self.split = Split(finalized_slot, bytes(finalized_state_root))
+
+    # ----------------------------------------------------------- forwards iter
+    def forwards_block_roots_iterator(
+        self, start_slot: int, end_slot: int, head_state
+    ):
+        """Yield (slot, block_root) over [start_slot, end_slot]: freezer
+        chunks below the split, the head state's block_roots above it
+        (reference: forwards_iter.rs HybridForwardsBlockRootsIterator)."""
+        p = self.spec.preset
+        chunk_cache: tuple[int, bytes | None] | None = None
+        for slot in range(start_slot, end_slot + 1):
+            if slot < self.split.slot:
+                # one KV read per 128-slot chunk, not per slot
+                chunk_index = slot // self.config.chunk_size
+                if chunk_cache is None or chunk_cache[0] != chunk_index:
+                    chunk_cache = (
+                        chunk_index,
+                        self.db.get(COL_COLD_BLOCK_ROOTS, _enc_u64(chunk_index)),
+                    )
+                chunk = chunk_cache[1]
+                if chunk is None:
+                    root = None
+                else:
+                    i = (slot % self.config.chunk_size) * 32
+                    r = chunk[i : i + 32]
+                    root = r if len(r) == 32 and r != b"\x00" * 32 else None
+            else:
+                if int(head_state.slot) - slot > p.SLOTS_PER_HISTORICAL_ROOT:
+                    raise StoreError("slot out of the head state's root window")
+                if slot >= int(head_state.slot):
+                    break
+                root = bytes(head_state.block_roots[slot % p.SLOTS_PER_HISTORICAL_ROOT])
+            if root is not None:
+                yield slot, root
+
+    # --------------------------------------------------------------- metadata
+    def put_meta(self, key: bytes, value: bytes) -> None:
+        self.db.put(COL_META, key, value)
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        return self.db.get(COL_META, key)
+
+    def set_genesis_block_root(self, root: bytes) -> None:
+        self.put_meta(KEY_GENESIS_BLOCK_ROOT, root)
+
+    def genesis_block_root(self) -> bytes | None:
+        return self.get_meta(KEY_GENESIS_BLOCK_ROOT)

@@ -1,0 +1,169 @@
+"""ItemStore implementations: native lhkv (disk) and MemoryStore (tests).
+
+Capability mirror of the reference's `beacon_node/store` ItemStore trait
+with its LevelDB (`leveldb_store.rs`) and in-memory (`memory_store.rs`)
+backends. Keys are (column, key) pairs flattened as column-prefixed byte
+keys, like the reference's `get_key_for_col`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Iterator
+
+
+def _flat(column: bytes, key: bytes) -> bytes:
+    return column + b":" + key
+
+
+class MemoryStore:
+    """Ordered in-memory store (reference: memory_store.rs)."""
+
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+
+    def get(self, column: bytes, key: bytes) -> bytes | None:
+        return self._data.get(_flat(column, key))
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        self._data[_flat(column, key)] = bytes(value)
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        self._data.pop(_flat(column, key), None)
+
+    def exists(self, column: bytes, key: bytes) -> bool:
+        return _flat(column, key) in self._data
+
+    def batch(self, ops: list[tuple]) -> None:
+        """ops: ("put", col, key, val) | ("del", col, key) — applied
+        atomically from the caller's perspective."""
+        for op in ops:
+            if op[0] == "put":
+                self.put(op[1], op[2], op[3])
+            else:
+                self.delete(op[1], op[2])
+
+    def iter_column(self, column: bytes) -> Iterator[tuple[bytes, bytes]]:
+        prefix = column + b":"
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k[len(prefix):], self._data[k]
+
+    def compact(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __len__(self):
+        return len(self._data)
+
+
+class KVStore:
+    """Disk store over the native lhkv engine (lighthouse_tpu/native)."""
+
+    def __init__(self, path: str):
+        from ..native import load_lhkv
+
+        self._lib = load_lhkv()
+        self._db = self._lib.lhkv_open(path.encode())
+        if not self._db:
+            raise IOError(f"lhkv_open failed for {path}")
+        self.path = path
+
+    def get(self, column: bytes, key: bytes) -> bytes | None:
+        fk = _flat(column, key)
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_size_t()
+        rc = self._lib.lhkv_get(self._db, fk, len(fk), ctypes.byref(val), ctypes.byref(vlen))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise IOError(f"lhkv_get rc={rc}")
+        try:
+            return ctypes.string_at(val, vlen.value)
+        finally:
+            self._lib.lhkv_free(val)
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        fk = _flat(column, key)
+        rc = self._lib.lhkv_put(self._db, fk, len(fk), bytes(value), len(value))
+        if rc != 0:
+            raise IOError(f"lhkv_put rc={rc}")
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        fk = _flat(column, key)
+        rc = self._lib.lhkv_delete(self._db, fk, len(fk))
+        if rc != 0:
+            raise IOError(f"lhkv_delete rc={rc}")
+
+    def exists(self, column: bytes, key: bytes) -> bool:
+        fk = _flat(column, key)
+        return bool(self._lib.lhkv_exists(self._db, fk, len(fk)))
+
+    def batch(self, ops: list[tuple]) -> None:
+        """One atomic append for the whole batch (single lhkv_batch call)."""
+        buf = bytearray()
+        for op in ops:
+            if op[0] == "put":
+                fk = _flat(op[1], op[2])
+                val = bytes(op[3])
+                buf.append(1)
+                buf += struct.pack("<II", len(fk), len(val))
+                buf += fk
+                buf += val
+            else:
+                fk = _flat(op[1], op[2])
+                buf.append(2)
+                buf += struct.pack("<II", len(fk), 0)
+                buf += fk
+        if not buf:
+            return
+        rc = self._lib.lhkv_batch(self._db, bytes(buf), len(buf))
+        if rc != 0:
+            raise IOError(f"lhkv_batch rc={rc}")
+
+    def iter_column(self, column: bytes) -> Iterator[tuple[bytes, bytes]]:
+        prefix = column + b":"
+        it = self._lib.lhkv_iter(self._db, prefix, len(prefix))
+        try:
+            while True:
+                k = ctypes.POINTER(ctypes.c_uint8)()
+                klen = ctypes.c_size_t()
+                v = ctypes.POINTER(ctypes.c_uint8)()
+                vlen = ctypes.c_size_t()
+                rc = self._lib.lhkv_iter_next(
+                    it, ctypes.byref(k), ctypes.byref(klen),
+                    ctypes.byref(v), ctypes.byref(vlen),
+                )
+                if rc == 1:
+                    return
+                if rc != 0:
+                    raise IOError(f"lhkv_iter_next rc={rc}")
+                try:
+                    yield (
+                        ctypes.string_at(k, klen.value)[len(prefix):],
+                        ctypes.string_at(v, vlen.value),
+                    )
+                finally:
+                    self._lib.lhkv_free(k)
+                    self._lib.lhkv_free(v)
+        finally:
+            self._lib.lhkv_iter_close(it)
+
+    def sync(self) -> None:
+        self._lib.lhkv_sync(self._db)
+
+    def compact(self) -> None:
+        rc = self._lib.lhkv_compact(self._db)
+        if rc != 0:
+            raise IOError(f"lhkv_compact rc={rc}")
+
+    def close(self) -> None:
+        if self._db:
+            self._lib.lhkv_close(self._db)
+            self._db = None
+
+    def __len__(self):
+        return int(self._lib.lhkv_count(self._db))

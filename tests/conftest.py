@@ -19,6 +19,22 @@ for hardware).
 """
 
 import os
+import resource
+
+# XLA's CPU compile of the pairing pipeline overflows the default 8 MB
+# thread stack (segfault in test_parallel); raise the limit BEFORE jax
+# spawns its compiler threads so they inherit it.
+try:
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    _want = (
+        resource.RLIM_INFINITY
+        if _hard == resource.RLIM_INFINITY
+        else min(_hard, 512 * 1024 * 1024)
+    )
+    if _soft != resource.RLIM_INFINITY and (_want == resource.RLIM_INFINITY or _soft < _want):
+        resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
+except (ValueError, OSError):
+    pass
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

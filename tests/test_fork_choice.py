@@ -181,6 +181,23 @@ def test_invalid_execution_payload_excludes_subtree(spec):
     assert got != root(2)
 
 
+def test_latest_valid_block_not_invalidated(spec):
+    """Invalidation with latest_valid_hash naming an already-VALID block
+    must leave that block VALID (regression: it used to be flipped)."""
+    fc = make_fc(spec)
+    fc.process_block(
+        ProtoBlock(
+            slot=1, root=root(1), parent_root=root(0), state_root=ZERO,
+            target_root=root(1), justified_checkpoint=(1, root(0)),
+            finalized_checkpoint=(1, root(0)),
+            execution_status=ExecutionStatus.VALID,
+            execution_block_hash=b"\x01" * 32,
+        )
+    )
+    fc.proto_array.process_execution_payload_invalidation(root(1), b"\x01" * 32)
+    assert fc.get_block(root(1)).execution_status is ExecutionStatus.VALID
+
+
 def test_valid_payload_propagates_to_ancestors(spec):
     fc = make_fc(spec)
     for i, (slot, r, p) in enumerate([(1, root(1), root(0)), (2, root(2), root(1))]):

@@ -1,0 +1,224 @@
+"""Store tests: native lhkv engine, MemoryStore, HotColdDB split store.
+
+Mirrors the reference's beacon_node/store tests (hot_cold_store.rs tests +
+store_tests.rs): roundtrips, epoch-boundary snapshots + replayed hot
+states, freezer migration with restore points, forwards iteration.
+"""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.genesis import interop_genesis_state, interop_keypairs
+from lighthouse_tpu.consensus.transition.slot import process_slots
+from lighthouse_tpu.store.hot_cold import HotColdDB, StoreConfig, StoreError
+from lighthouse_tpu.store.kv import KVStore, MemoryStore
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def genesis_state(spec):
+    from lighthouse_tpu.crypto.bls import backends
+
+    prev = backends._default
+    backends.set_default_backend("fake")
+    try:
+        return interop_genesis_state(
+            interop_keypairs(16), 1_600_000_000, spec, sign_deposits=False
+        )
+    finally:
+        backends._default = prev
+
+
+# ------------------------------------------------------------------ engines
+
+
+@pytest.mark.parametrize("kind", ["memory", "kv"])
+def test_item_store_roundtrip(tmp_path, kind):
+    db = (
+        MemoryStore()
+        if kind == "memory"
+        else KVStore(os.path.join(tmp_path, "db.lhkv"))
+    )
+    db.put(b"blk", b"a", b"1")
+    db.put(b"blk", b"c", b"3")
+    db.put(b"blk", b"b", b"2")
+    db.put(b"ste", b"a", b"other-column")
+    assert db.get(b"blk", b"a") == b"1"
+    assert db.get(b"blk", b"zz") is None
+    assert [k for k, _ in db.iter_column(b"blk")] == [b"a", b"b", b"c"]
+    db.batch([("del", b"blk", b"a"), ("put", b"blk", b"d", b"4")])
+    assert not db.exists(b"blk", b"a")
+    assert db.get(b"blk", b"d") == b"4"
+    db.close()
+
+
+def test_kv_persistence_and_compaction(tmp_path):
+    path = os.path.join(tmp_path, "db.lhkv")
+    db = KVStore(path)
+    for i in range(50):
+        db.put(b"c", bytes([i]), os.urandom(64))
+    for i in range(40):
+        db.delete(b"c", bytes([i]))
+    db.close()
+    db = KVStore(path)
+    assert len(db) == 10
+    size_before = os.path.getsize(path)
+    db.compact()
+    assert os.path.getsize(path) < size_before
+    assert len(db) == 10
+    db.close()
+
+
+# ----------------------------------------------------------------- HotColdDB
+
+
+@pytest.fixture()
+def hot_cold(spec):
+    return HotColdDB(MemoryStore(), spec, StoreConfig(slots_per_restore_point=8))
+
+
+def test_state_roundtrip_epoch_boundary(hot_cold, genesis_state):
+    root = genesis_state.hash_tree_root()
+    hot_cold.put_state(root, genesis_state)
+    got = hot_cold.get_state(root)
+    assert got is not None
+    assert got.hash_tree_root() == root
+
+
+def test_hot_state_replay_from_boundary(hot_cold, genesis_state, spec, fake_backend):
+    # boundary snapshot at genesis
+    g_root = genesis_state.hash_tree_root()
+    hot_cold.put_state(g_root, genesis_state)
+    # advance 3 empty slots; only the summary is stored (non-boundary)
+    state = process_slots(genesis_state.copy(), 3, spec)
+    root = state.hash_tree_root()
+    hot_cold.put_state(root, state)
+    got = hot_cold.get_state(root)
+    assert got is not None
+    assert got.slot == 3
+    assert got.hash_tree_root() == root
+
+
+def test_missing_state_returns_none(hot_cold):
+    assert hot_cold.get_state(b"\x77" * 32) is None
+
+
+def test_migration_to_freezer(hot_cold, genesis_state, spec, fake_backend):
+    p = spec.preset
+    # store states for slots 0..16 (two epochs)
+    state = genesis_state.copy()
+    roots = {}
+    hot_cold.put_state(state.hash_tree_root(), state)
+    roots[0] = state.hash_tree_root()
+    for slot in range(1, 17):
+        state = process_slots(state, slot, spec)
+        r = state.hash_tree_root()
+        roots[slot] = r
+        hot_cold.put_state(r, state)
+
+    finalized = state  # slot 16, epoch 2 boundary
+    hot_cold.migrate(finalized, b"\x00" * 32)
+    assert hot_cold.split.slot == 16
+
+    # hot states below the split were deleted
+    assert hot_cold.db.get(b"ste", roots[8]) is None
+    assert hot_cold.db.get(b"sum", roots[3]) is None
+    # cold roots recorded
+    for slot in range(0, 16):
+        assert hot_cold.cold_state_root_at_slot(slot) == bytes(roots[slot])
+    # restore points at 0 and 8 -> cold reads replay to any slot
+    cold = hot_cold.get_cold_state_by_slot(11)
+    assert cold is not None
+    assert cold.slot == 11
+    assert cold.hash_tree_root() == roots[11]
+    cold0 = hot_cold.get_cold_state_by_slot(0)
+    assert cold0.hash_tree_root() == roots[0]
+
+
+def test_forwards_block_roots_iterator(hot_cold, genesis_state, spec, fake_backend):
+    state = genesis_state.copy()
+    hot_cold.put_state(state.hash_tree_root(), state)
+    for slot in range(1, 17):
+        state = process_slots(state, slot, spec)
+        hot_cold.put_state(state.hash_tree_root(), state)
+    hot_cold.migrate(state, b"\x00" * 32)
+    head = process_slots(state.copy(), 20, spec)
+    got = list(hot_cold.forwards_block_roots_iterator(0, 19, head))
+    slots = [s for s, _ in got]
+    assert slots == list(range(0, 20))
+    # roots are consistent across the split boundary
+    for s, root in got:
+        if s < 16:
+            assert hot_cold.cold_block_root_at_slot(s) == root
+
+
+def test_block_roundtrip(hot_cold, spec, genesis_state):
+    from lighthouse_tpu.consensus.types import spec_types
+
+    t = spec_types(spec.preset)
+    block = t.SIGNED_BLOCK_BY_FORK["phase0"]()
+    block.message.slot = 5
+    block.message.parent_root = b"\x01" * 32
+    root = block.message.hash_tree_root()
+    hot_cold.put_block(root, block)
+    got = hot_cold.get_block(root)
+    assert got is not None
+    assert got.message.slot == 5
+    assert bytes(got.message.parent_root) == b"\x01" * 32
+    assert hot_cold.block_exists(root)
+    assert not hot_cold.block_exists(b"\x99" * 32)
+
+
+def test_compact_refused_during_iteration(tmp_path):
+    """Iterator snapshots hold offsets into the pre-compaction log; compact
+    must refuse while one is open (regression)."""
+    db = KVStore(os.path.join(tmp_path, "db.lhkv"))
+    for i in range(10):
+        db.put(b"c", bytes([i]), b"v" * 100)
+    for i in range(5):
+        db.delete(b"c", bytes([i]))
+    it = db.iter_column(b"c")
+    next(it)
+    with pytest.raises(IOError):
+        db.compact()
+    # drain -> compact succeeds
+    list(it)
+    db.compact()
+    assert len(db) == 5
+    db.close()
+
+
+def test_migrate_requires_epoch_alignment(hot_cold, genesis_state, spec, fake_backend):
+    state = process_slots(genesis_state.copy(), 3, spec)
+    with pytest.raises(StoreError):
+        hot_cold.migrate(state, b"\x00" * 32)
+
+
+def test_migrate_garbage_collects_forked_states(hot_cold, genesis_state, spec, fake_backend):
+    state = genesis_state.copy()
+    hot_cold.put_state(state.hash_tree_root(), state)
+    # a fork state that never becomes canonical
+    fork = process_slots(genesis_state.copy(), 2, spec)
+    fork.genesis_time += 1  # diverge
+    fork_root = fork.hash_tree_root()
+    hot_cold.put_state(fork_root, fork)
+    for slot in range(1, 9):
+        state = process_slots(state, slot, spec)
+        hot_cold.put_state(state.hash_tree_root(), state)
+    hot_cold.migrate(state, b"\x00" * 32)
+    assert hot_cold.db.get(b"sum", fork_root) is None
+
+
+def test_schema_version_check(tmp_path, spec):
+    import struct
+
+    db = MemoryStore()
+    db.put(b"met", b"schema", struct.pack(">Q", 99))
+    with pytest.raises(StoreError):
+        HotColdDB(db, spec)
