@@ -1,0 +1,25 @@
+"""Beacon-API layer (reference: beacon_node/http_api, 8.9k LoC warp +
+common/eth2 typed client, 4.2k LoC).
+
+* ``json_codec``  — eth2-API JSON conventions (ints as decimal strings,
+  bytes as 0x-hex, bitfields as SSZ-hex) derived from SSZ schemas.
+* ``beacon_api``  — transport-agnostic endpoint handlers over a
+  BeaconChain + NetworkService (http_api/src/lib.rs:256 filter tree).
+* ``server``      — stdlib threading HTTP server adapter + SSE events.
+* ``client``      — BeaconNodeClient (common/eth2/src/lib.rs:134):
+  typed access over real HTTP or direct in-process dispatch.
+"""
+
+from .beacon_api import ApiError, BeaconApi
+from .client import BeaconNodeClient
+from .json_codec import container_from_json, container_to_json
+from .server import HttpServer
+
+__all__ = [
+    "ApiError",
+    "BeaconApi",
+    "BeaconNodeClient",
+    "HttpServer",
+    "container_from_json",
+    "container_to_json",
+]

@@ -1,0 +1,711 @@
+"""Beacon-API endpoint handlers (reference: beacon_node/http_api/src/lib.rs).
+
+Transport-agnostic: every endpoint is a method taking parsed path/query
+arguments and returning JSON-ready dicts; ``server.HttpServer`` mounts
+them on real HTTP and ``client.BeaconNodeClient`` can call them
+directly in-process (the pattern the reference gets from warp filters +
+`common/eth2`'s typed client).
+
+Implemented endpoint families (http_api/src/lib.rs:256-...):
+beacon/{genesis, states/*, headers, blocks, pool/*}, node/*, config/*,
+validator/{duties/*, blocks, attestation_data, aggregate_attestation,
+aggregate_and_proofs, contribution_and_proofs}, events, and the
+lighthouse/* introspection extensions.
+"""
+
+from __future__ import annotations
+
+from ..chain.beacon_chain import AttestationError, BlockError
+from ..consensus import helpers as h
+from ..consensus.transition.advance import partial_state_advance
+from ..consensus.types import state_fork_name
+from .json_codec import container_from_json, container_to_json
+
+VERSION = "lighthouse-tpu/0.1.0"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+    def body(self) -> dict:
+        return {"code": self.status, "message": self.message}
+
+
+def _bad(cond: bool, message: str, status: int = 400):
+    if not cond:
+        raise ApiError(status, message)
+
+
+class EventBroker:
+    """SSE fan-out (reference: http_api/src/events.rs over the chain's
+    event handler). Subscribers get (topic, json_payload) tuples via
+    ``drain``; queues are bounded (oldest dropped) and at most
+    ``MAX_SUBSCRIBERS`` live at once (oldest subscription evicted)."""
+
+    TOPICS = ("head", "block", "attestation", "finalized_checkpoint", "exit")
+    MAX_QUEUE = 1024
+    MAX_SUBSCRIBERS = 64
+
+    def __init__(self):
+        from collections import deque
+
+        self._deque = deque
+        self._subs: list[tuple[set, object]] = []
+
+    def subscribe(self, topics):
+        queue = self._deque(maxlen=self.MAX_QUEUE)
+        self._subs.append((set(topics), queue))
+        if len(self._subs) > self.MAX_SUBSCRIBERS:
+            self._subs.pop(0)
+        return queue
+
+    def drain(self, queue) -> list:
+        out = []
+        while queue:
+            out.append(queue.popleft())
+        return out
+
+    def publish(self, topic: str, payload: dict) -> None:
+        for topics, queue in self._subs:
+            if topic in topics:
+                queue.append((topic, payload))
+
+
+class BeaconApi:
+    def __init__(self, chain, network=None):
+        self.chain = chain
+        self.network = network
+        self.events = EventBroker()
+
+    # ----------------------------------------------------------- state access
+    def _state_for_id(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head().state
+        if state_id == "genesis":
+            genesis_root = chain.store.genesis_block_root()
+            block = chain.store.get_block(genesis_root)
+            return chain.store.get_state(bytes(block.message.state_root))
+        if state_id == "finalized":
+            _, root = chain.finalized_checkpoint()
+            state = chain._state_for_block_root(root)
+            _bad(state is not None, "finalized state unavailable", 404)
+            return state
+        if state_id.startswith("0x"):
+            state = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            _bad(state is not None, "state not found", 404)
+            return state
+        try:
+            slot = int(state_id)
+        except ValueError:
+            raise ApiError(400, f"invalid state id {state_id!r}")
+        head = chain.head()
+        if slot == int(head.state.slot):
+            return head.state
+        for s, root in chain.store.forwards_block_roots_iterator(
+            slot, slot, head.state
+        ):
+            block = chain.store.get_block(root)
+            if block is not None and int(block.message.slot) <= slot:
+                return chain.store.get_state(bytes(block.message.state_root), slot)
+        raise ApiError(404, f"no state at slot {slot}")
+
+    def _block_for_id(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            return chain.head().root, chain.head().block
+        if block_id == "genesis":
+            root = chain.store.genesis_block_root()
+            return root, chain.store.get_block(root)
+        if block_id == "finalized":
+            _, root = chain.finalized_checkpoint()
+            block = chain.store.get_block(root)
+            _bad(block is not None, "finalized block unavailable", 404)
+            return root, block
+        if block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+            block = chain.store.get_block(root)
+            _bad(block is not None, "block not found", 404)
+            return root, block
+        try:
+            slot = int(block_id)
+        except ValueError:
+            raise ApiError(400, f"invalid block id {block_id!r}")
+        head = chain.head()
+        if slot == int(head.block.message.slot):
+            return chain.head().root, head.block
+        for s, root in chain.store.forwards_block_roots_iterator(
+            slot, slot, head.state
+        ):
+            block = chain.store.get_block(root)
+            if block is not None and int(block.message.slot) == slot:
+                return root, block
+        raise ApiError(404, f"no canonical block at slot {slot}")
+
+    # --------------------------------------------------------------- /beacon
+    def get_genesis(self) -> dict:
+        chain = self.chain
+        genesis_root = chain.store.genesis_block_root()
+        block = chain.store.get_block(genesis_root)
+        state = chain.store.get_state(bytes(block.message.state_root))
+        return {
+            "data": {
+                "genesis_time": str(int(state.genesis_time)),
+                "genesis_validators_root": "0x"
+                + bytes(state.genesis_validators_root).hex(),
+                "genesis_fork_version": "0x"
+                + chain.spec.GENESIS_FORK_VERSION.hex(),
+            }
+        }
+
+    def get_state_root(self, state_id: str) -> dict:
+        state = self._state_for_id(state_id)
+        return {"data": {"root": "0x" + state.hash_tree_root().hex()}}
+
+    def get_state_fork(self, state_id: str) -> dict:
+        state = self._state_for_id(state_id)
+        return {"data": container_to_json(state.fork)}
+
+    def get_finality_checkpoints(self, state_id: str) -> dict:
+        state = self._state_for_id(state_id)
+        return {
+            "data": {
+                "previous_justified": container_to_json(
+                    state.previous_justified_checkpoint
+                ),
+                "current_justified": container_to_json(
+                    state.current_justified_checkpoint
+                ),
+                "finalized": container_to_json(state.finalized_checkpoint),
+            }
+        }
+
+    def get_validators(self, state_id: str, indices=None, statuses=None) -> dict:
+        state = self._state_for_id(state_id)
+        spec = self.chain.spec
+        epoch = h.get_current_epoch(state, spec)
+        out = []
+        for i, v in enumerate(state.validators):
+            if indices is not None and i not in indices:
+                continue
+            status = _validator_status(v, epoch, spec)
+            if statuses is not None and status not in statuses:
+                continue
+            out.append(
+                {
+                    "index": str(i),
+                    "balance": str(int(state.balances[i])),
+                    "status": status,
+                    "validator": container_to_json(v),
+                }
+            )
+        return {"data": out}
+
+    def get_validator(self, state_id: str, validator_id: str) -> dict:
+        state = self._state_for_id(state_id)
+        index = self._validator_index(state, validator_id)
+        _bad(index is not None, "validator not found", 404)
+        spec = self.chain.spec
+        v = state.validators[index]
+        return {
+            "data": {
+                "index": str(index),
+                "balance": str(int(state.balances[index])),
+                "status": _validator_status(
+                    v, h.get_current_epoch(state, spec), spec
+                ),
+                "validator": container_to_json(v),
+            }
+        }
+
+    def _validator_index(self, state, validator_id: str):
+        if validator_id.startswith("0x"):
+            pk = bytes.fromhex(validator_id[2:])
+            for i, v in enumerate(state.validators):
+                if bytes(v.pubkey) == pk:
+                    return i
+            return None
+        try:
+            i = int(validator_id)
+        except ValueError:
+            raise ApiError(400, f"invalid validator id {validator_id!r}")
+        return i if 0 <= i < len(state.validators) else None
+
+    def get_validator_balances(self, state_id: str, indices=None) -> dict:
+        state = self._state_for_id(state_id)
+        return {
+            "data": [
+                {"index": str(i), "balance": str(int(b))}
+                for i, b in enumerate(state.balances)
+                if indices is None or i in indices
+            ]
+        }
+
+    def get_committees(self, state_id: str, epoch=None, index=None, slot=None) -> dict:
+        state = self._state_for_id(state_id)
+        spec = self.chain.spec
+        p = spec.preset
+        epoch = int(epoch) if epoch is not None else h.get_current_epoch(state, spec)
+        out = []
+        for s in range(epoch * p.SLOTS_PER_EPOCH, (epoch + 1) * p.SLOTS_PER_EPOCH):
+            if slot is not None and s != int(slot):
+                continue
+            count = h.get_committee_count_per_slot(state, epoch, spec)
+            for ci in range(count):
+                if index is not None and ci != int(index):
+                    continue
+                committee = h.get_beacon_committee(state, s, ci, spec)
+                out.append(
+                    {
+                        "index": str(ci),
+                        "slot": str(s),
+                        "validators": [str(int(v)) for v in committee],
+                    }
+                )
+        return {"data": out}
+
+    def get_header(self, block_id: str) -> dict:
+        root, block = self._block_for_id(block_id)
+        return {"data": self._header_entry(root, block)}
+
+    def get_headers(self, slot=None, parent_root=None) -> dict:
+        if slot is not None:
+            root, block = self._block_for_id(str(int(slot)))
+            return {"data": [self._header_entry(root, block)]}
+        head = self.chain.head()
+        return {"data": [self._header_entry(head.root, head.block)]}
+
+    def _header_entry(self, root: bytes, signed_block) -> dict:
+        msg = signed_block.message
+        return {
+            "root": "0x" + root.hex(),
+            "canonical": True,
+            "header": {
+                "message": {
+                    "slot": str(int(msg.slot)),
+                    "proposer_index": str(int(msg.proposer_index)),
+                    "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                    "state_root": "0x" + bytes(msg.state_root).hex(),
+                    "body_root": "0x" + msg.body.hash_tree_root().hex(),
+                },
+                "signature": "0x" + bytes(signed_block.signature).hex(),
+            },
+        }
+
+    def get_block(self, block_id: str) -> dict:
+        root, block = self._block_for_id(block_id)
+        return {
+            "version": type(block.message).fork,
+            "data": container_to_json(block),
+        }
+
+    def get_block_root(self, block_id: str) -> dict:
+        root, _ = self._block_for_id(block_id)
+        return {"data": {"root": "0x" + root.hex()}}
+
+    def get_block_attestations(self, block_id: str) -> dict:
+        _, block = self._block_for_id(block_id)
+        return {
+            "data": [
+                container_to_json(a) for a in block.message.body.attestations
+            ]
+        }
+
+    def publish_block(self, block_json_or_obj) -> dict:
+        chain = self.chain
+        if isinstance(block_json_or_obj, dict):
+            fork = chain.spec.fork_name_at_epoch(
+                int(block_json_or_obj["message"]["slot"])
+                // chain.spec.preset.SLOTS_PER_EPOCH
+            )
+            block = container_from_json(
+                chain.types.SIGNED_BLOCK_BY_FORK[fork], block_json_or_obj
+            )
+        else:
+            block = block_json_or_obj
+        # gossip first, then import (http_api publish semantics)
+        if self.network is not None:
+            self.network.publish_block(block)
+        try:
+            root = chain.process_block(block)
+        except BlockError as e:
+            raise ApiError(400, f"block rejected: {e}")
+        self.events.publish("block", {
+            "slot": str(int(block.message.slot)),
+            "block": "0x" + root.hex(),
+        })
+        self.events.publish("head", {
+            "slot": str(int(block.message.slot)),
+            "block": "0x" + chain.head().root.hex(),
+            "state": "0x" + bytes(block.message.state_root).hex(),
+        })
+        return {}
+
+    # ------------------------------------------------------------ /pool
+    def pool_attestations(self, att_json_list) -> dict:
+        chain = self.chain
+        failures = []
+        for i, data in enumerate(att_json_list):
+            att = (
+                container_from_json(chain.types.Attestation, data)
+                if isinstance(data, dict)
+                else data
+            )
+            try:
+                verified = chain.verify_unaggregated_attestation_for_gossip(att)
+            except AttestationError as e:
+                failures.append({"index": i, "message": str(e)})
+                continue
+            chain.apply_attestation_to_fork_choice(verified)
+            chain.add_to_naive_aggregation_pool(verified)
+            if self.network is not None:
+                self.network.publish_attestation(att)
+            self.events.publish(
+                "attestation", container_to_json(att)
+            )
+        if failures:
+            raise ApiError(400, f"some attestations failed: {failures}")
+        return {}
+
+    def get_pool_attestations(self) -> dict:
+        return {
+            "data": [
+                container_to_json(a)
+                for a in self.chain.op_pool.all_attestations()
+            ]
+        }
+
+    def pool_voluntary_exit(self, exit_json_or_obj) -> dict:
+        from ..consensus.types import SignedVoluntaryExit
+        from ..consensus.verify_operation import OperationError, verify_exit
+
+        chain = self.chain
+        signed = (
+            container_from_json(SignedVoluntaryExit, exit_json_or_obj)
+            if isinstance(exit_json_or_obj, dict)
+            else exit_json_or_obj
+        )
+        try:
+            op = verify_exit(
+                chain.head().state, signed, chain.spec, backend=chain.backend
+            )
+        except OperationError as e:
+            raise ApiError(400, f"exit rejected: {e}")
+        chain.op_pool.insert_voluntary_exit(op)
+        if self.network is not None:
+            self.network.publish_voluntary_exit(signed)
+        self.events.publish("exit", container_to_json(signed))
+        return {}
+
+    # ----------------------------------------------------------------- /debug
+    def get_debug_state(self, state_id: str) -> dict:
+        """Full BeaconState JSON (eth/v2/debug/beacon/states — the
+        checkpoint-sync download, builder.rs:252-365 consumer side)."""
+        state = self._state_for_id(state_id)
+        return {
+            "version": state_fork_name(state),
+            "data": container_to_json(state),
+        }
+
+    # ------------------------------------------------------------------ /node
+    def node_version(self) -> dict:
+        return {"data": {"version": VERSION}}
+
+    def node_health(self) -> int:
+        return 200
+
+    def node_syncing(self) -> dict:
+        head_slot = int(self.chain.head().block.message.slot)
+        current = self.chain.current_slot()
+        distance = max(0, current - head_slot)
+        return {
+            "data": {
+                "head_slot": str(head_slot),
+                "sync_distance": str(distance),
+                "is_syncing": distance > 1,
+                "is_optimistic": False,
+            }
+        }
+
+    def node_identity(self) -> dict:
+        node_id = self.network.node_id if self.network else "solo"
+        return {
+            "data": {
+                "peer_id": node_id,
+                "enr": "",
+                "p2p_addresses": [],
+                "discovery_addresses": [],
+                "metadata": {"seq_number": "0", "attnets": "0x", "syncnets": "0x"},
+            }
+        }
+
+    def node_peers(self) -> dict:
+        if self.network is None:
+            return {"data": [], "meta": {"count": 0}}
+        peers = self.network.peer_manager.connected_peers()
+        return {
+            "data": [
+                {
+                    "peer_id": p,
+                    "state": "connected",
+                    "direction": "outbound",
+                    "last_seen_p2p_address": "",
+                }
+                for p in peers
+            ],
+            "meta": {"count": len(peers)},
+        }
+
+    # ---------------------------------------------------------------- /config
+    def config_spec(self) -> dict:
+        spec = self.chain.spec
+        p = spec.preset
+        out = {}
+        for name in (
+            "SECONDS_PER_SLOT",
+            "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT",
+            "ETH1_FOLLOW_DISTANCE",
+            "GENESIS_DELAY",
+            "CHURN_LIMIT_QUOTIENT",
+            "MIN_PER_EPOCH_CHURN_LIMIT",
+        ):
+            if hasattr(spec, name):
+                out[name] = str(getattr(spec, name))
+        for name in (
+            "SLOTS_PER_EPOCH",
+            "TARGET_COMMITTEE_SIZE",
+            "MAX_COMMITTEES_PER_SLOT",
+            "SHARD_COMMITTEE_PERIOD",
+            "SYNC_COMMITTEE_SIZE",
+        ):
+            out[name] = str(getattr(p, name))
+        out["PRESET_BASE"] = p.name
+        out["GENESIS_FORK_VERSION"] = "0x" + spec.GENESIS_FORK_VERSION.hex()
+        return {"data": out}
+
+    def config_fork_schedule(self) -> dict:
+        spec = self.chain.spec
+        forks = [
+            {
+                "previous_version": "0x" + spec.GENESIS_FORK_VERSION.hex(),
+                "current_version": "0x" + spec.GENESIS_FORK_VERSION.hex(),
+                "epoch": "0",
+            }
+        ]
+        if spec.ALTAIR_FORK_EPOCH is not None:
+            forks.append(
+                {
+                    "previous_version": "0x" + spec.GENESIS_FORK_VERSION.hex(),
+                    "current_version": "0x" + spec.ALTAIR_FORK_VERSION.hex(),
+                    "epoch": str(spec.ALTAIR_FORK_EPOCH),
+                }
+            )
+        if spec.BELLATRIX_FORK_EPOCH is not None:
+            forks.append(
+                {
+                    "previous_version": "0x" + spec.ALTAIR_FORK_VERSION.hex(),
+                    "current_version": "0x" + spec.BELLATRIX_FORK_VERSION.hex(),
+                    "epoch": str(spec.BELLATRIX_FORK_EPOCH),
+                }
+            )
+        return {"data": forks}
+
+    def config_deposit_contract(self) -> dict:
+        spec = self.chain.spec
+        address = getattr(spec, "DEPOSIT_CONTRACT_ADDRESS", b"\x00" * 20)
+        return {
+            "data": {
+                "chain_id": str(getattr(spec, "DEPOSIT_CHAIN_ID", 1)),
+                "address": "0x" + bytes(address).hex(),
+            }
+        }
+
+    # ------------------------------------------------------------- /validator
+    def _duties_state(self, epoch: int):
+        """State inside ``epoch``, bounded to [0, current_epoch + 1]:
+        the reference serves duties only for current/next epoch (future
+        RANDAO is undetermined; unbounded advance is a DoS vector)."""
+        chain = self.chain
+        p = chain.spec.preset
+        current_epoch = max(
+            chain.current_slot(), int(chain.head().state.slot)
+        ) // p.SLOTS_PER_EPOCH
+        _bad(0 <= epoch <= current_epoch + 1,
+             f"duties epoch {epoch} outside [0, {current_epoch + 1}]")
+        state = chain.head().state
+        target_slot = epoch * p.SLOTS_PER_EPOCH
+        if int(state.slot) < target_slot:
+            return partial_state_advance(
+                state.copy(), None, target_slot, chain.spec
+            )
+        if int(state.slot) // p.SLOTS_PER_EPOCH > epoch:
+            # past epoch: replay a canonical state, then make sure it
+            # actually reaches the epoch (a skipped epoch-start slot
+            # leaves the stored state one epoch back)
+            state = self._state_for_id(str(target_slot))
+            if int(state.slot) < target_slot:
+                state = partial_state_advance(
+                    state.copy(), None, target_slot, chain.spec
+                )
+        return state
+
+    def duties_proposer(self, epoch: int) -> dict:
+        chain = self.chain
+        p = chain.spec.preset
+        epoch = int(epoch)
+        target_slot = epoch * p.SLOTS_PER_EPOCH
+        state = self._duties_state(epoch)
+        duties = []
+        for slot in range(target_slot, target_slot + p.SLOTS_PER_EPOCH):
+            index = h.get_beacon_proposer_index_at_slot(state, slot, chain.spec)
+            duties.append(
+                {
+                    "pubkey": "0x" + bytes(state.validators[index].pubkey).hex(),
+                    "validator_index": str(index),
+                    "slot": str(slot),
+                }
+            )
+        return {
+            "dependent_root": "0x" + self._proposer_dependent_root(epoch).hex(),
+            "data": duties,
+        }
+
+    def _proposer_dependent_root(self, epoch: int) -> bytes:
+        p = self.chain.spec.preset
+        decision_slot = epoch * p.SLOTS_PER_EPOCH - 1
+        if decision_slot < 0:
+            return self.chain.genesis_block_root
+        root = self.chain.fork_choice.proto.ancestor_at_slot(
+            self.chain.head().root, decision_slot
+        )
+        return root if root is not None else self.chain.genesis_block_root
+
+    def duties_attester(self, epoch: int, indices) -> dict:
+        chain = self.chain
+        p = chain.spec.preset
+        epoch = int(epoch)
+        target_slot = epoch * p.SLOTS_PER_EPOCH
+        state = self._duties_state(epoch)
+        want = {int(i) for i in indices}
+        duties = []
+        for slot in range(target_slot, target_slot + p.SLOTS_PER_EPOCH):
+            count = h.get_committee_count_per_slot(state, epoch, chain.spec)
+            for ci in range(count):
+                committee = h.get_beacon_committee(state, slot, ci, chain.spec)
+                for pos, vi in enumerate(committee):
+                    if int(vi) in want:
+                        duties.append(
+                            {
+                                "pubkey": "0x"
+                                + bytes(state.validators[int(vi)].pubkey).hex(),
+                                "validator_index": str(int(vi)),
+                                "committee_index": str(ci),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(count),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        decision_root = chain._shuffling_decision_root(epoch)
+        return {"dependent_root": "0x" + decision_root.hex(), "data": duties}
+
+    def produce_block(self, slot: int, randao_reveal: str, graffiti=None) -> dict:
+        chain = self.chain
+        reveal = (
+            bytes.fromhex(randao_reveal.removeprefix("0x"))
+            if isinstance(randao_reveal, str)
+            else randao_reveal
+        )
+        graffiti_bytes = (
+            bytes.fromhex(graffiti.removeprefix("0x")) if graffiti else b""
+        )
+        block, _ = chain.produce_block(reveal, int(slot), graffiti_bytes)
+        return {
+            "version": type(block).fork,
+            "data": container_to_json(block),
+        }
+
+    def attestation_data(self, slot: int, committee_index: int) -> dict:
+        att = self.chain.produce_unaggregated_attestation(
+            int(slot), int(committee_index)
+        )
+        return {"data": container_to_json(att.data)}
+
+    def aggregate_attestation(self, slot: int, attestation_data_root: str) -> dict:
+        root = bytes.fromhex(attestation_data_root.removeprefix("0x"))
+        entry = self.chain.naive_aggregation_pool.get_by_root(root)
+        _bad(entry is not None, "no aggregate for data root", 404)
+        data, bits, sig = entry
+        att = self.chain.types.Attestation(
+            aggregation_bits=bits, data=data, signature=sig.to_bytes()
+        )
+        return {"data": container_to_json(att)}
+
+    def publish_aggregate_and_proofs(self, aggregates) -> dict:
+        chain = self.chain
+        failures = []
+        for i, data in enumerate(aggregates):
+            agg = (
+                container_from_json(chain.types.SignedAggregateAndProof, data)
+                if isinstance(data, dict)
+                else data
+            )
+            try:
+                verified = chain.verify_aggregated_attestation_for_gossip(agg)
+            except AttestationError as e:
+                failures.append({"index": i, "message": str(e)})
+                continue
+            chain.apply_attestation_to_fork_choice(verified)
+            chain.add_to_operation_pool(verified)
+            if self.network is not None:
+                self.network.publish_aggregate(agg)
+        if failures:
+            raise ApiError(400, f"some aggregates failed: {failures}")
+        return {}
+
+    def subscribe_beacon_committee(self, subscriptions) -> dict:
+        return {}  # subnet subscriptions are a no-op on the full-mesh hub
+
+    # ------------------------------------------------------------ /lighthouse
+    def lighthouse_syncing_state(self) -> dict:
+        if self.network is None:
+            return {"data": "Synced"}
+        return {"data": self.network.sync.state.value}
+
+    def lighthouse_proto_array(self) -> dict:
+        proto = self.chain.fork_choice.proto.proto_array
+        return {
+            "data": {
+                "nodes": [
+                    {
+                        "slot": str(n.slot),
+                        "root": "0x" + n.root.hex(),
+                        "parent": n.parent,
+                        "weight": str(n.weight),
+                    }
+                    for n in proto.nodes
+                ]
+            }
+        }
+
+
+def _validator_status(v, epoch: int, spec) -> str:
+    """Condensed eth2 validator status taxonomy."""
+    from ..consensus.config import FAR_FUTURE_EPOCH
+
+    if int(v.activation_epoch) > epoch:
+        return (
+            "pending_queued"
+            if int(v.activation_eligibility_epoch) <= epoch
+            else "pending_initialized"
+        )
+    if int(v.exit_epoch) == FAR_FUTURE_EPOCH:
+        return "active_slashed" if v.slashed else "active_ongoing"
+    if epoch < int(v.exit_epoch):
+        return "active_exiting"
+    if epoch < int(v.withdrawable_epoch):
+        return "exited_slashed" if v.slashed else "exited_unslashed"
+    return "withdrawal_possible"

@@ -1,0 +1,189 @@
+"""BeaconNodeClient — the typed Beacon-API client.
+
+Capability mirror of `common/eth2/src/lib.rs:134` (BeaconNodeHttpClient):
+every endpoint the validator client / checkpoint sync / simulator needs,
+as typed methods. Two transports:
+
+* ``BeaconNodeClient(url=...)``  — real HTTP via urllib (the production
+  path against ``server.HttpServer`` or any Beacon-API node);
+* ``BeaconNodeClient(api=...)``  — direct in-process dispatch onto a
+  ``BeaconApi`` (the reference's pattern of handing the harness's
+  client to services in tests, without sockets).
+
+Raises ``ApiError`` on non-2xx, mirroring eth2::Error::StatusCode.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .beacon_api import ApiError, BeaconApi
+
+
+class BeaconNodeClient:
+    def __init__(self, url: str | None = None, api: BeaconApi | None = None,
+                 timeout: float = 10.0):
+        if (url is None) == (api is None):
+            raise ValueError("exactly one of url/api required")
+        self.url = url.rstrip("/") if url else None
+        self.api = api
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- transport
+    def _http(self, method: str, path: str, body=None):
+        req = urllib.request.Request(
+            self.url + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read())
+                message = detail.get("message", str(e))
+            except Exception:
+                message = str(e)
+            raise ApiError(e.code, message) from None
+
+    def _get(self, path: str, direct, *args, **kwargs):
+        if self.api is not None:
+            return direct(*args, **kwargs)
+        return self._http("GET", path)
+
+    def _post(self, path: str, direct, *args, body=None, **kwargs):
+        if self.api is not None:
+            return direct(*args, **kwargs)
+        return self._http("POST", path, body=body)
+
+    # --------------------------------------------------------------- beacon
+    def get_genesis(self):
+        return self._get("/eth/v1/beacon/genesis", lambda: self.api.get_genesis())
+
+    def get_state_fork(self, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/fork",
+            lambda: self.api.get_state_fork(state_id),
+        )
+
+    def get_finality_checkpoints(self, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+            lambda: self.api.get_finality_checkpoints(state_id),
+        )
+
+    def get_validators(self, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators",
+            lambda: self.api.get_validators(state_id),
+        )
+
+    def get_validator(self, validator_id, state_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+            lambda: self.api.get_validator(state_id, str(validator_id)),
+        )
+
+    def get_header(self, block_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/headers/{block_id}",
+            lambda: self.api.get_header(block_id),
+        )
+
+    def get_block(self, block_id="head"):
+        return self._get(
+            f"/eth/v2/beacon/blocks/{block_id}",
+            lambda: self.api.get_block(block_id),
+        )
+
+    def get_block_root(self, block_id="head"):
+        return self._get(
+            f"/eth/v1/beacon/blocks/{block_id}/root",
+            lambda: self.api.get_block_root(block_id),
+        )
+
+    def publish_block(self, block_json):
+        return self._post(
+            "/eth/v1/beacon/blocks",
+            lambda: self.api.publish_block(block_json),
+            body=block_json,
+        )
+
+    def post_pool_attestations(self, atts_json):
+        return self._post(
+            "/eth/v1/beacon/pool/attestations",
+            lambda: self.api.pool_attestations(atts_json),
+            body=atts_json,
+        )
+
+    def post_voluntary_exit(self, exit_json):
+        return self._post(
+            "/eth/v1/beacon/pool/voluntary_exits",
+            lambda: self.api.pool_voluntary_exit(exit_json),
+            body=exit_json,
+        )
+
+    def get_debug_state(self, state_id="head"):
+        return self._get(
+            f"/eth/v2/debug/beacon/states/{state_id}",
+            lambda: self.api.get_debug_state(state_id),
+        )
+
+    # ----------------------------------------------------------------- node
+    def node_version(self):
+        return self._get("/eth/v1/node/version", lambda: self.api.node_version())
+
+    def node_syncing(self):
+        return self._get("/eth/v1/node/syncing", lambda: self.api.node_syncing())
+
+    def config_spec(self):
+        return self._get("/eth/v1/config/spec", lambda: self.api.config_spec())
+
+    # ------------------------------------------------------------- validator
+    def get_proposer_duties(self, epoch: int):
+        return self._get(
+            f"/eth/v1/validator/duties/proposer/{int(epoch)}",
+            lambda: self.api.duties_proposer(epoch),
+        )
+
+    def post_attester_duties(self, epoch: int, indices):
+        return self._post(
+            f"/eth/v1/validator/duties/attester/{int(epoch)}",
+            lambda: self.api.duties_attester(epoch, indices),
+            body=[str(int(i)) for i in indices],
+        )
+
+    def produce_block(self, slot: int, randao_reveal: str, graffiti=None):
+        q = f"?randao_reveal={randao_reveal}"
+        if graffiti:
+            q += f"&graffiti={graffiti}"
+        return self._get(
+            f"/eth/v2/validator/blocks/{int(slot)}{q}",
+            lambda: self.api.produce_block(slot, randao_reveal, graffiti),
+        )
+
+    def attestation_data(self, slot: int, committee_index: int):
+        return self._get(
+            f"/eth/v1/validator/attestation_data?slot={int(slot)}"
+            f"&committee_index={int(committee_index)}",
+            lambda: self.api.attestation_data(slot, committee_index),
+        )
+
+    def aggregate_attestation(self, slot: int, attestation_data_root: str):
+        return self._get(
+            f"/eth/v1/validator/aggregate_attestation?slot={int(slot)}"
+            f"&attestation_data_root={attestation_data_root}",
+            lambda: self.api.aggregate_attestation(slot, attestation_data_root),
+        )
+
+    def post_aggregate_and_proofs(self, aggregates_json):
+        return self._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            lambda: self.api.publish_aggregate_and_proofs(aggregates_json),
+            body=aggregates_json,
+        )

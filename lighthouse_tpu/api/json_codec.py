@@ -1,0 +1,60 @@
+"""eth2 Beacon-API JSON conventions, derived from SSZ schemas.
+
+The reference hand-writes serde impls (`consensus/serde_utils`): uints
+as decimal strings, fixed/variable bytes as 0x-hex, bitfields as the
+0x-hex of their SSZ encoding, containers as objects. Deriving the codec
+from the SSZ schema (which every container already declares) gives the
+same wire format without a second type description.
+"""
+
+from __future__ import annotations
+
+from ..consensus import ssz
+
+
+def value_to_json(schema, value):
+    if isinstance(schema, ssz.Uint):
+        return str(int(value))
+    if isinstance(schema, ssz.Boolean):
+        return bool(value)
+    if isinstance(schema, (ssz.ByteVector, ssz.ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(schema, (ssz.Bitlist, ssz.Bitvector)):
+        return "0x" + schema.encode(list(value)).hex()
+    if isinstance(schema, (ssz.List, ssz.Vector)):
+        return [value_to_json(schema.elem, v) for v in value]
+    if isinstance(schema, ssz._ContainerSchema):
+        return container_to_json(value)
+    raise TypeError(f"unhandled schema {type(schema).__name__}")
+
+
+def value_from_json(schema, data):
+    if isinstance(schema, ssz.Uint):
+        return int(data)
+    if isinstance(schema, ssz.Boolean):
+        return bool(data)
+    if isinstance(schema, (ssz.ByteVector, ssz.ByteList)):
+        return bytes.fromhex(str(data).removeprefix("0x"))
+    if isinstance(schema, (ssz.Bitlist, ssz.Bitvector)):
+        return schema.decode(bytes.fromhex(str(data).removeprefix("0x")))
+    if isinstance(schema, (ssz.List, ssz.Vector)):
+        return [value_from_json(schema.elem, v) for v in data]
+    if isinstance(schema, ssz._ContainerSchema):
+        return container_from_json(schema.cls, data)
+    raise TypeError(f"unhandled schema {type(schema).__name__}")
+
+
+def container_to_json(obj) -> dict:
+    return {
+        name: value_to_json(schema, getattr(obj, name))
+        for name, schema in obj.fields.items()
+    }
+
+
+def container_from_json(cls, data: dict):
+    kwargs = {
+        name: value_from_json(schema, data[name])
+        for name, schema in cls.fields.items()
+        if name in data
+    }
+    return cls(**kwargs)

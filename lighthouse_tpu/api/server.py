@@ -1,0 +1,191 @@
+"""HTTP adapter for the BeaconApi (reference: warp serve at
+http_api/src/lib.rs:256; the metrics server at http_metrics).
+
+A stdlib ``ThreadingHTTPServer`` with a regex route table mapping the
+eth2 Beacon-API paths onto ``BeaconApi`` methods, plus `/eth/v1/events`
+as Server-Sent Events and an optional `/metrics` Prometheus exposition
+hook. Runs on an ephemeral port for tests (`node_test_rig` pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .beacon_api import ApiError, BeaconApi
+
+# (method, path regex) -> handler name + path-arg names
+ROUTES: list[tuple[str, re.Pattern, str, tuple[str, ...]]] = []
+
+
+def route(method: str, pattern: str, name: str, args: tuple[str, ...] = ()):
+    ROUTES.append((method, re.compile(f"^{pattern}$"), name, args))
+
+
+route("GET", r"/eth/v1/beacon/genesis", "get_genesis")
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/root", "get_state_root", ("state_id",))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/fork", "get_state_fork", ("state_id",))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/finality_checkpoints", "get_finality_checkpoints", ("state_id",))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators", "get_validators", ("state_id",))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validators/(?P<validator_id>[^/]+)", "get_validator", ("state_id", "validator_id"))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/validator_balances", "get_validator_balances", ("state_id",))
+route("GET", r"/eth/v1/beacon/states/(?P<state_id>[^/]+)/committees", "get_committees", ("state_id",))
+route("GET", r"/eth/v1/beacon/headers", "get_headers")
+route("GET", r"/eth/v1/beacon/headers/(?P<block_id>[^/]+)", "get_header", ("block_id",))
+route("GET", r"/eth/v2/beacon/blocks/(?P<block_id>[^/]+)", "get_block", ("block_id",))
+route("GET", r"/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/root", "get_block_root", ("block_id",))
+route("GET", r"/eth/v1/beacon/blocks/(?P<block_id>[^/]+)/attestations", "get_block_attestations", ("block_id",))
+route("POST", r"/eth/v1/beacon/blocks", "publish_block")
+route("POST", r"/eth/v1/beacon/pool/attestations", "pool_attestations")
+route("GET", r"/eth/v1/beacon/pool/attestations", "get_pool_attestations")
+route("POST", r"/eth/v1/beacon/pool/voluntary_exits", "pool_voluntary_exit")
+route("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)", "get_debug_state", ("state_id",))
+route("GET", r"/eth/v1/node/version", "node_version")
+route("GET", r"/eth/v1/node/syncing", "node_syncing")
+route("GET", r"/eth/v1/node/identity", "node_identity")
+route("GET", r"/eth/v1/node/peers", "node_peers")
+route("GET", r"/eth/v1/config/spec", "config_spec")
+route("GET", r"/eth/v1/config/fork_schedule", "config_fork_schedule")
+route("GET", r"/eth/v1/config/deposit_contract", "config_deposit_contract")
+route("GET", r"/eth/v1/validator/duties/proposer/(?P<epoch>\d+)", "duties_proposer", ("epoch",))
+route("POST", r"/eth/v1/validator/duties/attester/(?P<epoch>\d+)", "duties_attester", ("epoch",))
+route("GET", r"/eth/v2/validator/blocks/(?P<slot>\d+)", "produce_block", ("slot",))
+route("GET", r"/eth/v1/validator/attestation_data", "attestation_data")
+route("GET", r"/eth/v1/validator/aggregate_attestation", "aggregate_attestation")
+route("POST", r"/eth/v1/validator/aggregate_and_proofs", "publish_aggregate_and_proofs")
+route("POST", r"/eth/v1/validator/beacon_committee_subscriptions", "subscribe_beacon_committee")
+route("GET", r"/lighthouse/syncing", "lighthouse_syncing_state")
+route("GET", r"/lighthouse/proto_array", "lighthouse_proto_array")
+
+# handlers whose body is the single positional payload
+BODY_AS_PAYLOAD = {
+    "publish_block",
+    "pool_attestations",
+    "pool_voluntary_exit",
+    "publish_aggregate_and_proofs",
+    "subscribe_beacon_committee",
+}
+# query params forwarded as keyword arguments (ints where sensible)
+QUERY_KWARGS = {
+    "get_validators": ("indices",),
+    "get_validator_balances": ("indices",),
+    "get_committees": ("epoch", "index", "slot"),
+    "get_headers": ("slot", "parent_root"),
+    "produce_block": ("randao_reveal", "graffiti"),
+    "attestation_data": ("slot", "committee_index"),
+    "aggregate_attestation": ("slot", "attestation_data_root"),
+}
+INT_QUERY_PARAMS = {"epoch", "index", "slot", "committee_index"}
+
+
+class HttpServer:
+    """Serve a BeaconApi over HTTP; ephemeral port by default."""
+
+    def __init__(self, api: BeaconApi, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        api_ref = api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self, method: str):
+                parsed = urlparse(self.path)
+                if method == "GET" and parsed.path == "/eth/v1/node/health":
+                    self.send_response(api_ref.node_health())
+                    self.end_headers()
+                    return
+                if method == "GET" and parsed.path == "/eth/v1/events":
+                    return self._serve_events(parsed)
+                for m, pattern, name, arg_names in ROUTES:
+                    if m != method:
+                        continue
+                    match = pattern.match(parsed.path)
+                    if not match:
+                        continue
+                    return self._call(name, match, parsed)
+                self._respond(404, {"code": 404, "message": "not found"})
+
+            def _call(self, name: str, match, parsed):
+                handler = getattr(api_ref, name)
+                kwargs = dict(match.groupdict())
+                query = {
+                    k: v[0] if len(v) == 1 else v
+                    for k, v in parse_qs(parsed.query).items()
+                }
+                for k in QUERY_KWARGS.get(name, ()):
+                    if k in query:
+                        v = query[k]
+                        if k in INT_QUERY_PARAMS:
+                            v = int(v)
+                        kwargs[k] = v
+                if name == "get_validators" and "indices" in kwargs:
+                    kwargs["indices"] = [
+                        int(x) for x in str(kwargs["indices"]).split(",")
+                    ]
+                args = []
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if name in BODY_AS_PAYLOAD or name == "duties_attester":
+                    payload = json.loads(body) if body else None
+                    if name == "duties_attester":
+                        kwargs["indices"] = [int(x) for x in (payload or [])]
+                    else:
+                        args.append(payload)
+                try:
+                    result = handler(*args, **kwargs)
+                except ApiError as e:
+                    return self._respond(e.status, e.body())
+                except Exception as e:  # pragma: no cover - defensive
+                    return self._respond(500, {"code": 500, "message": repr(e)})
+                self._respond(200, result)
+
+            def _serve_events(self, parsed):
+                topics = parse_qs(parsed.query).get("topics", ["head"])
+                if len(topics) == 1 and "," in topics[0]:
+                    topics = topics[0].split(",")
+                queue = api_ref.events.subscribe(topics)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                # drain whatever is queued, then close (poll-style SSE —
+                # deterministic for tests; a long-lived client re-polls)
+                for topic, payload in api_ref.events.drain(queue):
+                    chunk = f"event: {topic}\ndata: {json.dumps(payload)}\n\n"
+                    self.wfile.write(chunk.encode())
+                self.wfile.flush()
+
+            def _respond(self, status: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

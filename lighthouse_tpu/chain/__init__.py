@@ -1,0 +1,10 @@
+"""Chain core (reference: beacon_node/beacon_chain)."""
+
+from .beacon_chain import (  # noqa: F401
+    AttestationError,
+    BeaconChain,
+    BlockError,
+    VerifiedAttestation,
+)
+from .harness import BeaconChainHarness  # noqa: F401
+from .pubkey_cache import ValidatorPubkeyCache  # noqa: F401

@@ -1,0 +1,204 @@
+"""Chain-level caches and anti-equivocation observation sets.
+
+Capability mirrors (reference paths in beacon_node/beacon_chain/src/):
+
+* ShufflingCache (shuffling_cache.rs) — CommitteeCaches keyed by
+  (target_epoch, shuffling_decision_root).
+* SnapshotCache (snapshot_cache.rs) — recent post-states by block root, so
+  block import starts from a warm pre-state.
+* BeaconProposerCache (beacon_proposer_cache.rs) — proposer indices per
+  (epoch, decision_root).
+* ObservedAttesters / ObservedAggregates / ObservedBlockProducers /
+  ObservedOperations (observed_*.rs) — dedup/equivocation guards for
+  gossip.
+* NaiveAggregationPool (naive_aggregation_pool.rs) — aggregates
+  unaggregated gossip attestations per data root until aggregators pick
+  them up.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, defaultdict
+
+from ..consensus.committee_cache import CommitteeCache
+from ..crypto.bls.api import AggregateSignature
+
+
+class ShufflingCache:
+    """(epoch, decision_root) -> CommitteeCache, bounded LRU."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._map: OrderedDict[tuple, CommitteeCache] = OrderedDict()
+
+    def get(self, epoch: int, decision_root: bytes) -> CommitteeCache | None:
+        key = (epoch, bytes(decision_root))
+        cache = self._map.get(key)
+        if cache is not None:
+            self._map.move_to_end(key)
+        return cache
+
+    def get_or_init(self, state, epoch: int, decision_root: bytes, spec):
+        cache = self.get(epoch, decision_root)
+        if cache is None:
+            cache = CommitteeCache.initialized(state, epoch, spec)
+            self.insert(epoch, decision_root, cache)
+        return cache
+
+    def insert(self, epoch: int, decision_root: bytes, cache: CommitteeCache):
+        key = (epoch, bytes(decision_root))
+        self._map[key] = cache
+        self._map.move_to_end(key)
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+
+class SnapshotCache:
+    """block_root -> (pre_state for children of that block). Bounded."""
+
+    def __init__(self, capacity: int = 4):
+        self.capacity = capacity
+        self._map: OrderedDict[bytes, object] = OrderedDict()
+
+    def insert(self, block_root: bytes, state) -> None:
+        self._map[bytes(block_root)] = state
+        self._map.move_to_end(bytes(block_root))
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+    def get_cloned(self, block_root: bytes):
+        state = self._map.get(bytes(block_root))
+        return state.copy() if state is not None else None
+
+    def get_state_for_block_processing(self, block_root: bytes):
+        """Remove-and-return (the caller consumes the snapshot)."""
+        return self._map.pop(bytes(block_root), None)
+
+
+class BeaconProposerCache:
+    """(epoch, decision_root) -> [proposer index per slot in epoch]."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._map: OrderedDict[tuple, list[int]] = OrderedDict()
+
+    def get_slot(self, epoch: int, decision_root: bytes, slot: int, slots_per_epoch: int) -> int | None:
+        entry = self._map.get((epoch, bytes(decision_root)))
+        if entry is None:
+            return None
+        return entry[slot % slots_per_epoch]
+
+    def insert(self, epoch: int, decision_root: bytes, proposers: list[int]):
+        self._map[(epoch, bytes(decision_root))] = list(proposers)
+        while len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+
+class ObservedAttesters:
+    """(validator, target_epoch) dedup for unaggregated attestations
+    (reference: observed_attesters.rs). Finalized epochs are pruned."""
+
+    def __init__(self):
+        self._seen: dict[int, set[int]] = defaultdict(set)  # epoch -> validators
+
+    def observe(self, epoch: int, validator_index: int) -> bool:
+        """Returns True if ALREADY seen (i.e. duplicate)."""
+        seen = validator_index in self._seen[epoch]
+        self._seen[epoch].add(validator_index)
+        return seen
+
+    def is_known(self, epoch: int, validator_index: int) -> bool:
+        return validator_index in self._seen.get(epoch, ())
+
+    def prune(self, finalized_epoch: int) -> None:
+        for e in [e for e in self._seen if e < finalized_epoch]:
+            del self._seen[e]
+
+
+class ObservedAggregates:
+    """Attestation-root dedup for aggregates, and (aggregator, epoch)
+    tracking (reference: observed_aggregates.rs)."""
+
+    def __init__(self):
+        self._roots: dict[int, set[bytes]] = defaultdict(set)  # epoch -> att roots
+        self._aggregators: dict[int, set[int]] = defaultdict(set)
+
+    def observe_root(self, epoch: int, att_root: bytes) -> bool:
+        seen = att_root in self._roots[epoch]
+        self._roots[epoch].add(att_root)
+        return seen
+
+    def observe_aggregator(self, epoch: int, aggregator_index: int) -> bool:
+        seen = aggregator_index in self._aggregators[epoch]
+        self._aggregators[epoch].add(aggregator_index)
+        return seen
+
+    def prune(self, finalized_epoch: int) -> None:
+        for m in (self._roots, self._aggregators):
+            for e in [e for e in m if e < finalized_epoch]:
+                del m[e]
+
+
+class ObservedBlockProducers:
+    """(proposer, slot) equivocation guard (observed_block_producers.rs).
+
+    Gossip verification only *checks* (``is_known``); the pipeline
+    records (``observe``) after the block fully verifies, so junk
+    blocks cannot poison a (slot, proposer) pair the honest proposer
+    still needs (reference: observe_proposer placement after the
+    proposal-signature check in block_verification.rs)."""
+
+    def __init__(self):
+        self._seen: dict[int, set[int]] = defaultdict(set)  # slot -> proposers
+
+    def observe(self, slot: int, proposer_index: int) -> bool:
+        seen = proposer_index in self._seen[slot]
+        self._seen[slot].add(proposer_index)
+        return seen
+
+    def is_known(self, slot: int, proposer_index: int) -> bool:
+        return proposer_index in self._seen.get(slot, ())
+
+    def prune(self, finalized_slot: int) -> None:
+        for s in [s for s in self._seen if s < finalized_slot]:
+            del self._seen[s]
+
+
+class NaiveAggregationPool:
+    """Aggregate unaggregated attestations per data root until the slot's
+    aggregators collect them (reference: naive_aggregation_pool.rs)."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self):
+        # data_root -> (data, bits, AggregateSignature)
+        self._map: dict[bytes, tuple] = {}
+
+    def insert(self, attestation) -> None:
+        root = attestation.data.hash_tree_root()
+        bits = list(attestation.aggregation_bits)
+        sig = AggregateSignature.from_bytes(bytes(attestation.signature))
+        entry = self._map.get(root)
+        if entry is None:
+            self._map[root] = (attestation.data, bits, sig)
+            return
+        _, ebits, esig = entry
+        if len(ebits) != len(bits):
+            return
+        if any(a and b for a, b in zip(ebits, bits)):
+            return  # overlapping: drop (the op pool handles the general case)
+        merged = [a or b for a, b in zip(ebits, bits)]
+        esig.add_assign_aggregate(sig)
+        self._map[root] = (entry[0], merged, esig)
+
+    def get(self, data) -> tuple | None:
+        return self._map.get(data.hash_tree_root())
+
+    def get_by_root(self, data_root: bytes) -> tuple | None:
+        return self._map.get(bytes(data_root))
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = current_slot - self.SLOTS_RETAINED
+        self._map = {
+            r: e for r, e in self._map.items() if int(e[0].slot) >= cutoff
+        }

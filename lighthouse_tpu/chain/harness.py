@@ -1,0 +1,205 @@
+"""BeaconChainHarness — a full in-process chain for tests.
+
+Capability mirror of the reference's
+`beacon_node/beacon_chain/src/test_utils.rs:452`: a BeaconChain on
+MemoryStore with a ManualSlotClock and deterministic interop keypairs,
+able to produce signed blocks (with pooled attestations) and have every
+validator attest — the engine behind the reference's 8.5k LoC of chain
+integration tests and the simulator.
+
+``backend="fake"`` (default) runs with the always-valid BLS backend and
+infinity signatures, isolating consensus logic from crypto cost exactly
+like the reference's fake_crypto CI runs; ``backend="python"``/"jax"
+produce real signatures.
+"""
+
+from __future__ import annotations
+
+from ..common.slot_clock import ManualSlotClock
+from ..consensus import helpers as h
+from ..consensus.config import ChainSpec, compute_signing_root, minimal_spec
+from ..consensus.genesis import interop_genesis_state, interop_keypairs
+from ..consensus.types import Checkpoint, spec_types, state_fork_name
+from ..crypto.bls import backends as bls_backends
+from ..store.hot_cold import HotColdDB, StoreConfig
+from ..store.kv import MemoryStore
+from .beacon_chain import BeaconChain
+
+INFINITY_SIG = b"\xc0" + bytes(95)
+
+
+class BeaconChainHarness:
+    def __init__(
+        self,
+        validator_count: int = 16,
+        spec: ChainSpec | None = None,
+        backend: str = "fake",
+        genesis_time: int = 1_600_000_000,
+        store=None,
+    ):
+        self.spec = spec or minimal_spec()
+        self.backend = backend
+        self.sign = backend != "fake"
+        self.keys = interop_keypairs(validator_count)
+        self.types = spec_types(self.spec.preset)
+
+        genesis_state = interop_genesis_state(
+            self.keys, genesis_time, self.spec, sign_deposits=self.sign
+        ) if self.sign else self._fake_genesis(genesis_time)
+
+        self.slot_clock = ManualSlotClock(genesis_time, self.spec.SECONDS_PER_SLOT)
+        hot_cold = HotColdDB(
+            store if store is not None else MemoryStore(),
+            self.spec,
+            StoreConfig(slots_per_restore_point=self.spec.preset.SLOTS_PER_EPOCH),
+        )
+        self.chain = BeaconChain.from_genesis(
+            hot_cold, genesis_state, self.spec, self.slot_clock, backend=backend
+        )
+
+    def _fake_genesis(self, genesis_time):
+        prev = bls_backends._default
+        bls_backends.set_default_backend("fake")
+        try:
+            return interop_genesis_state(
+                self.keys, genesis_time, self.spec, sign_deposits=False
+            )
+        finally:
+            bls_backends._default = prev
+
+    # ------------------------------------------------------------------ time
+    def advance_slot(self) -> int:
+        self.slot_clock.advance_slot()
+        self.chain.per_slot_task()
+        return self.chain.current_slot()
+
+    def set_slot(self, slot: int) -> None:
+        self.slot_clock.set_slot(slot)
+        self.chain.per_slot_task()
+
+    # --------------------------------------------------------------- signing
+    def sign_block(self, block):
+        fork = type(block).fork
+        signed_cls = self.types.SIGNED_BLOCK_BY_FORK[fork]
+        if not self.sign:
+            return signed_cls(message=block, signature=INFINITY_SIG)
+        state = self.chain.head().state
+        epoch = int(block.slot) // self.spec.preset.SLOTS_PER_EPOCH
+        domain = self.spec.get_domain(
+            self.spec.DOMAIN_BEACON_PROPOSER,
+            epoch,
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        root = compute_signing_root(block, domain)
+        sig = self.keys[int(block.proposer_index)].sign(root)
+        return signed_cls(message=block, signature=sig.to_bytes())
+
+    def randao_reveal(self, proposer_index: int, slot: int) -> bytes:
+        if not self.sign:
+            return INFINITY_SIG
+        from ..consensus.ssz import merkleize_chunks, uint64
+
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        state = self.chain.head().state
+        domain = self.spec.get_domain(
+            self.spec.DOMAIN_RANDAO, epoch, state.fork,
+            self.chain.genesis_validators_root,
+        )
+        root = merkleize_chunks([uint64.hash_tree_root(epoch), domain])
+        return self.keys[proposer_index].sign(root).to_bytes()
+
+    # ------------------------------------------------------------ production
+    def make_block(self, slot: int | None = None):
+        """Produce + sign a block on the current head."""
+        slot = slot if slot is not None else self.chain.current_slot()
+        state = self.chain.head().state
+        adv = state
+        if int(state.slot) < slot:
+            from ..consensus.transition.advance import partial_state_advance
+
+            adv = partial_state_advance(state.copy(), None, slot, self.spec)
+        proposer = h.get_beacon_proposer_index(adv, self.spec)
+        block, _post = self.chain.produce_block(
+            self.randao_reveal(proposer, slot), slot
+        )
+        return self.sign_block(block)
+
+    def attest(self, slot: int | None = None, head_root: bytes | None = None):
+        """Every scheduled validator attests for ``slot``; attestations are
+        verified-for-gossip, applied to fork choice, and fed to the op pool
+        (reference: harness attest_to_head + process_attestations)."""
+        chain = self.chain
+        slot = slot if slot is not None else chain.current_slot()
+        p = self.spec.preset
+        state = chain.head().state
+        if int(state.slot) < slot:
+            from ..consensus.transition.advance import partial_state_advance
+
+            state = partial_state_advance(state.copy(), None, slot, self.spec)
+        epoch = slot // p.SLOTS_PER_EPOCH
+        cache = chain.shuffling_cache.get_or_init(
+            state, epoch, chain._shuffling_decision_root(epoch), self.spec
+        )
+        made = []
+        for index, committee in enumerate(cache.committees_at_slot(slot)):
+            proto = chain.produce_unaggregated_attestation(slot, index)
+            for pos, validator in enumerate(committee):
+                att = self.types.Attestation(
+                    aggregation_bits=[
+                        i == pos for i in range(len(committee))
+                    ],
+                    data=proto.data,
+                    signature=self._attestation_signature(
+                        int(validator), proto.data
+                    ),
+                )
+                made.append(att)
+        verified = chain.batch_verify_unaggregated_attestations_for_gossip(made)
+        out = []
+        for v in verified:
+            if isinstance(v, Exception):
+                raise v
+            chain.apply_attestation_to_fork_choice(v)
+            chain.add_to_operation_pool(v)
+            out.append(v)
+        return out
+
+    def _attestation_signature(self, validator_index: int, data) -> bytes:
+        if not self.sign:
+            return INFINITY_SIG
+        state = self.chain.head().state
+        domain = self.spec.get_domain(
+            self.spec.DOMAIN_BEACON_ATTESTER,
+            int(data.target.epoch),
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        root = compute_signing_root(data, domain)
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    # ------------------------------------------------------------- extension
+    def extend_chain(self, num_blocks: int, attest: bool = True) -> list[bytes]:
+        """Advance one slot per block: import a block, then have all
+        validators attest to the new head (reference: extend_chain)."""
+        roots = []
+        for _ in range(num_blocks):
+            slot = self.advance_slot()
+            block = self.make_block(slot)
+            root = self.chain.process_block(
+                block, block_delay_seconds=0.0
+            )
+            roots.append(root)
+            if attest:
+                self.attest(slot)
+        return roots
+
+    # ---------------------------------------------------------------- status
+    def head_slot(self) -> int:
+        return int(self.chain.head().block.message.slot)
+
+    def finalized_epoch(self) -> int:
+        return self.chain.finalized_checkpoint()[0]
+
+    def justified_epoch(self) -> int:
+        return self.chain.fork_choice.store.justified_checkpoint[0]

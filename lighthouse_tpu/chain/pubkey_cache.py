@@ -1,0 +1,78 @@
+"""ValidatorPubkeyCache — decompressed pubkeys by validator index.
+
+Capability mirror of the reference's
+`beacon_node/beacon_chain/src/validator_pubkey_cache.rs:20-24`: the
+registry's compressed 48-byte keys are decompressed ONCE at import and
+kept indexed by validator index, persisted to the store
+(disk-before-memory ordering, :77-120), so signature-set assembly never
+pays decompression. In the TPU design this cache is also the source for
+the on-HBM pubkey table (SURVEY §7.1 blsrt).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..crypto.bls.api import PublicKey
+
+COL_PUBKEY = b"pkc"
+
+
+class ValidatorPubkeyCache:
+    def __init__(self, store=None):
+        self.pubkeys: list[PublicKey] = []
+        self.indices: dict[bytes, int] = {}
+        self.store = store
+
+    @classmethod
+    def from_state(cls, state, store=None) -> "ValidatorPubkeyCache":
+        cache = cls(store)
+        cache.import_new_pubkeys(state)
+        return cache
+
+    @classmethod
+    def load_from_store(cls, store) -> "ValidatorPubkeyCache":
+        """(reference: validator_pubkey_cache.rs load_from_store:47-73)"""
+        cache = cls(store)
+        items = []
+        for key, raw in store.iter_column(COL_PUBKEY):
+            items.append((struct.unpack(">Q", key)[0], raw))
+        items.sort()
+        for i, (index, raw) in enumerate(items):
+            if index != i:
+                raise ValueError("pubkey cache hole in store")
+            pk = PublicKey.from_bytes(raw)
+            cache.indices[raw] = i
+            cache.pubkeys.append(pk)
+        return cache
+
+    def import_new_pubkeys(self, state) -> None:
+        """Append registry tail; writes the store BEFORE memory so a crash
+        leaves a prefix, never a hole (reference: :77-120)."""
+        ops = []
+        new = []
+        for i in range(len(self.pubkeys), len(state.validators)):
+            compressed = bytes(state.validators[i].pubkey)
+            pk = PublicKey.from_bytes(compressed)  # raises on invalid
+            ops.append(("put", COL_PUBKEY, struct.pack(">Q", i), compressed))
+            new.append((compressed, pk))
+        if self.store is not None and ops:
+            self.store.batch(ops)
+        for compressed, pk in new:
+            self.indices[compressed] = len(self.pubkeys)
+            self.pubkeys.append(pk)
+
+    def get(self, index: int) -> PublicKey | None:
+        if 0 <= index < len(self.pubkeys):
+            return self.pubkeys[index]
+        return None
+
+    def get_index(self, compressed: bytes) -> int | None:
+        return self.indices.get(bytes(compressed))
+
+    def __len__(self) -> int:
+        return len(self.pubkeys)
+
+    def as_getter(self):
+        """The get_pubkey closure shape signature_sets.py expects."""
+        return self.get

@@ -1,0 +1,311 @@
+"""The `lighthouse-tpu` CLI (reference: lighthouse/src/main.rs clap
+tree + beacon_node/src/cli.rs + validator_client/src/cli.rs +
+account_manager + lcli subcommands).
+
+Subcommands:
+
+* ``bn``        — run a beacon node (interop genesis or checkpoint sync,
+  optional HTTP API / metrics / slasher).
+* ``vc``        — run a validator client against one or more BNs.
+* ``account``   — wallet/validator tooling: keystore create/import/list
+  (account_manager).
+* ``lcli``      — dev utilities: interop-genesis, skip-slots,
+  transition-blocks, parse-ssz (testing/lcli).
+* ``db``        — database inspect/version (database_manager).
+* ``bench``     — the BLS device benchmark (bench.py's workload).
+
+Every subcommand melts flags into the component configs exactly as the
+reference's get_config does; `--spec minimal|mainnet` picks the preset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _spec_for(name: str):
+    from .consensus.config import mainnet_spec, minimal_spec
+
+    return minimal_spec() if name == "minimal" else mainnet_spec()
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--spec", choices=("minimal", "mainnet"), default="mainnet")
+    p.add_argument("--debug-level", default="info",
+                   choices=("debug", "info", "warn", "error", "crit"))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    root = argparse.ArgumentParser(
+        prog="lighthouse-tpu",
+        description="TPU-native Ethereum consensus client framework",
+    )
+    sub = root.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="beacon node")
+    _add_common(bn)
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--http", action="store_true")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--metrics", action="store_true")
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--interop-validators", type=int, default=64)
+    bn.add_argument("--checkpoint-sync-url", default=None)
+    bn.add_argument("--backend", default=None,
+                    choices=(None, "python", "jax", "fake"))
+    bn.add_argument("--slots", type=int, default=0,
+                    help="run N slots then exit (0 = forever)")
+
+    vc = sub.add_parser("vc", help="validator client")
+    _add_common(vc)
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052",
+                    help="comma-separated BN URLs (fallback order)")
+    vc.add_argument("--interop-validators", type=int, default=0,
+                    help="use deterministic interop keys [0..N)")
+    vc.add_argument("--keystores", nargs="*", default=[],
+                    help="EIP-2335 keystore JSON paths")
+    vc.add_argument("--password", default="")
+    vc.add_argument("--slashing-protection-db", default=":memory:")
+    vc.add_argument("--slots", type=int, default=0)
+
+    account = sub.add_parser("account", help="key management")
+    _add_common(account)
+    asub = account.add_subparsers(dest="action", required=True)
+    new = asub.add_parser("new", help="derive + encrypt a validator keystore")
+    new.add_argument("--seed-hex", required=True)
+    new.add_argument("--index", type=int, default=0)
+    new.add_argument("--password", required=True)
+    new.add_argument("--out", default="-")
+    imp = asub.add_parser("inspect", help="inspect a keystore")
+    imp.add_argument("path")
+    imp.add_argument("--password", default=None)
+
+    lcli = sub.add_parser("lcli", help="dev utilities")
+    _add_common(lcli)
+    lsub = lcli.add_subparsers(dest="action", required=True)
+    ig = lsub.add_parser("interop-genesis")
+    ig.add_argument("--validator-count", type=int, default=64)
+    ig.add_argument("--genesis-time", type=int, default=1_600_000_000)
+    sk = lsub.add_parser("skip-slots")
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--validator-count", type=int, default=16)
+    ps = lsub.add_parser("parse-ssz")
+    ps.add_argument("--type", dest="ssz_type", required=True,
+                    choices=("attestation", "signed_block", "state"))
+    ps.add_argument("path")
+
+    db = sub.add_parser("db", help="database tooling")
+    _add_common(db)
+    db.add_argument("--datadir", required=True)
+    db.add_argument("action", choices=("inspect", "version"))
+
+    bench = sub.add_parser("bench", help="BLS device benchmark")
+    bench.add_argument("--quick", action="store_true")
+
+    return root
+
+
+# ------------------------------------------------------------------ commands
+def run_bn(args) -> int:
+    from .common.logging import StructuredLogger
+    from .node import ClientBuilder, ClientConfig
+
+    log = StructuredLogger(level=args.debug_level)
+    spec = _spec_for(args.spec)
+    cfg = ClientConfig(
+        datadir=args.datadir,
+        validator_count=args.interop_validators,
+        http_enabled=args.http,
+        http_port=args.http_port,
+        metrics_enabled=args.metrics,
+        slasher_enabled=args.slasher,
+        backend=args.backend,
+        manual_clock=args.slots > 0,
+    )
+    builder = ClientBuilder(cfg, spec, log)
+    if args.datadir:
+        builder.disk_store(args.datadir)
+    else:
+        builder.memory_store()
+    if args.checkpoint_sync_url:
+        from .api import BeaconNodeClient
+
+        builder.checkpoint_sync(BeaconNodeClient(url=args.checkpoint_sync_url))
+    else:
+        builder.interop_genesis()
+    node = builder.build()
+    log.info(
+        "beacon node ready",
+        spec=args.spec,
+        http=node.http.url if node.http else "off",
+        head=node.chain.head().root.hex()[:8],
+    )
+    if args.slots > 0:
+        for _ in range(args.slots):
+            node.chain.slot_clock.advance_slot()
+            node.tick_slot()
+        log.info("done", head_slot=int(node.chain.head().block.message.slot))
+        node.stop()
+        return 0
+    node.start()
+    node.executor.block_on_shutdown()
+    return 0
+
+
+def run_vc(args) -> int:
+    from .api import BeaconNodeClient
+    from .common.logging import StructuredLogger
+    from .validator import BeaconNodeFallback, SlashingDatabase, ValidatorClient
+    from .validator.keystore import Keystore
+
+    log = StructuredLogger(level=args.debug_level)
+    spec = _spec_for(args.spec)
+    urls = [u.strip() for u in args.beacon_nodes.split(",") if u.strip()]
+    clients = [BeaconNodeClient(url=u) for u in urls]
+    client = clients[0] if len(clients) == 1 else BeaconNodeFallback(clients)
+
+    genesis = (clients[0].get_genesis())["data"]
+    gvr = bytes.fromhex(genesis["genesis_validators_root"].removeprefix("0x"))
+    vc = ValidatorClient(
+        client, spec, gvr, slashing_db=SlashingDatabase(args.slashing_protection_db)
+    )
+    if args.interop_validators:
+        from .consensus.genesis import interop_keypairs
+
+        vc.add_validators(interop_keypairs(args.interop_validators))
+    for path in args.keystores:
+        with open(path) as f:
+            vc.add_validators([Keystore.from_json(f.read()).decrypt(args.password)])
+    log.info("validator client ready", keys=len(vc.store.voting_pubkeys()))
+
+    import time
+
+    seconds = spec.SECONDS_PER_SLOT
+    genesis_time = int(genesis["genesis_time"])
+    count = 0
+    while args.slots == 0 or count < args.slots:
+        now = time.time()
+        slot = max(0, int(now - genesis_time) // seconds)
+        stats = vc.run_slot(slot)
+        log.info("slot done", slot=slot, **stats)
+        count += 1
+        if args.slots == 0:
+            time.sleep(max(0.0, (genesis_time + (slot + 1) * seconds) - time.time()))
+    return 0
+
+
+def run_account(args) -> int:
+    from .validator.keystore import Keystore, derive_validator_keys
+
+    if args.action == "new":
+        seed = bytes.fromhex(args.seed_hex.removeprefix("0x"))
+        signing, _ = derive_validator_keys(seed, args.index)
+        ks = Keystore.encrypt(
+            signing, args.password, path=f"m/12381/3600/{args.index}/0/0"
+        )
+        out = ks.to_json()
+        if args.out == "-":
+            print(out)
+        else:
+            with open(args.out, "w") as f:
+                f.write(out)
+        return 0
+    if args.action == "inspect":
+        with open(args.path) as f:
+            ks = Keystore.from_json(f.read())
+        info = {"pubkey": ks.pubkey, "path": ks.path, "uuid": ks.uuid}
+        if args.password is not None:
+            ks.decrypt(args.password)
+            info["decrypts"] = True
+        print(json.dumps(info, indent=2))
+        return 0
+    return 1
+
+
+def run_lcli(args) -> int:
+    from .chain.harness import BeaconChainHarness
+
+    spec = _spec_for(args.spec)
+    if args.action == "interop-genesis":
+        from .consensus.genesis import interop_genesis_state, interop_keypairs
+        from .crypto.bls import backends as bls_backends
+
+        bls_backends.set_default_backend("fake")
+        state = interop_genesis_state(
+            interop_keypairs(args.validator_count), args.genesis_time, spec,
+            sign_deposits=False,
+        )
+        print(json.dumps({
+            "genesis_validators_root": "0x"
+            + bytes(state.genesis_validators_root).hex(),
+            "genesis_time": int(state.genesis_time),
+            "validators": len(state.validators),
+        }))
+        return 0
+    if args.action == "skip-slots":
+        h = BeaconChainHarness(validator_count=args.validator_count, spec=spec)
+        from .consensus.transition.slot import process_slots
+
+        state = process_slots(
+            h.chain.head().state.copy(), args.slots, h.spec
+        )
+        print(json.dumps({
+            "slot": int(state.slot),
+            "state_root": "0x" + state.hash_tree_root().hex(),
+        }))
+        return 0
+    if args.action == "parse-ssz":
+        from .consensus.types import spec_types
+
+        t = spec_types(spec.preset)
+        with open(args.path, "rb") as f:
+            raw = f.read()
+        cls = {
+            "attestation": t.Attestation,
+            "signed_block": t.SIGNED_BLOCK_BY_FORK["phase0"],
+            "state": t.BeaconStatePhase0,
+        }[args.ssz_type]
+        from .api.json_codec import container_to_json
+
+        print(json.dumps(container_to_json(cls.decode(raw)), indent=2))
+        return 0
+    return 1
+
+
+def run_db(args) -> int:
+    from .store.kv import KVStore
+
+    store = KVStore(args.datadir)
+    if args.action == "version":
+        print(json.dumps({"schema_version": 1}))
+        return 0
+    counts: dict[str, int] = {}
+    for column in (b"blk", b"ste", b"sum", b"meta"):
+        counts[column.decode()] = sum(1 for _ in store.iter_column(column))
+    print(json.dumps(counts))
+    return 0
+
+
+def run_bench(args) -> int:
+    import subprocess
+
+    cmd = [sys.executable, "bench.py"] + (["--quick"] if args.quick else [])
+    return subprocess.call(cmd)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return {
+        "bn": run_bn,
+        "vc": run_vc,
+        "account": run_account,
+        "lcli": run_lcli,
+        "db": run_db,
+        "bench": run_bench,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

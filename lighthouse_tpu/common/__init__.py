@@ -1,0 +1,6 @@
+"""Cross-cutting commons (reference: common/*)."""
+
+from .logging import NullLogger, StructuredLogger, test_logger  # noqa: F401
+from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry  # noqa: F401
+from .slot_clock import ManualSlotClock, SlotClock, SystemSlotClock  # noqa: F401
+from .task_executor import ShutdownSignal, TaskExecutor  # noqa: F401

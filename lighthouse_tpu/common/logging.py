@@ -1,0 +1,92 @@
+"""Structured logging (reference: common/logging — slog terminal/file
+formatting, test_logger, and metrics on log counts).
+
+slog-style key-value structured records over the stdlib logging core:
+``log.info("Block imported", slot=5, root="0x…")`` renders as the
+reference's `INFO Block imported, slot: 5, root: 0x…` terminal format.
+A global counter per level feeds the metrics registry exactly like the
+reference counts log lines.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from .metrics import REGISTRY
+
+_LOG_COUNTS = None
+
+
+def _counts():
+    global _LOG_COUNTS
+    if _LOG_COUNTS is None:
+        _LOG_COUNTS = REGISTRY.counter(
+            "log_messages_total", "Log lines emitted", ("level",)
+        )
+    return _LOG_COUNTS
+
+
+class StructuredLogger:
+    LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40, "crit": 50}
+
+    def __init__(self, name: str = "lighthouse_tpu", level: str = "info",
+                 stream=None, fields: dict | None = None):
+        self.name = name
+        self.level = self.LEVELS[level]
+        self.stream = stream if stream is not None else sys.stderr
+        self.fields = dict(fields or {})
+
+    def bind(self, **fields) -> "StructuredLogger":
+        """Child logger with extra context (slog's o!())."""
+        merged = dict(self.fields)
+        merged.update(fields)
+        return StructuredLogger(
+            self.name, "debug", self.stream, merged
+        )._with_level(self.level)
+
+    def _with_level(self, level: int) -> "StructuredLogger":
+        self.level = level
+        return self
+
+    def _log(self, level_name: str, msg: str, kv: dict) -> None:
+        if self.LEVELS[level_name] < self.level:
+            return
+        _counts().inc(level=level_name)
+        merged = dict(self.fields)
+        merged.update(kv)
+        suffix = "".join(f", {k}: {v}" for k, v in merged.items())
+        ts = time.strftime("%b %d %H:%M:%S")
+        self.stream.write(
+            f"{ts} {level_name.upper():5s} {msg}{suffix}\n"
+        )
+
+    def debug(self, msg, **kv):
+        self._log("debug", msg, kv)
+
+    def info(self, msg, **kv):
+        self._log("info", msg, kv)
+
+    def warn(self, msg, **kv):
+        self._log("warn", msg, kv)
+
+    def error(self, msg, **kv):
+        self._log("error", msg, kv)
+
+    def crit(self, msg, **kv):
+        self._log("crit", msg, kv)
+
+
+class NullLogger(StructuredLogger):
+    """Discard everything (the reference's NullLoggerConfig for tests)."""
+
+    def __init__(self):
+        super().__init__(level="crit")
+
+    def _log(self, *a, **k):
+        pass
+
+
+def test_logger() -> StructuredLogger:
+    """Logger for tests: visible only when pytest shows output."""
+    return StructuredLogger(level="debug", stream=sys.stdout)

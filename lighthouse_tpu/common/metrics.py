@@ -1,0 +1,200 @@
+"""Metrics registry (reference: common/lighthouse_metrics — a global
+lazy_static Prometheus registry with try_create_* helpers; scraped by
+beacon_node/http_metrics).
+
+Counters, gauges and histograms with label support, rendered in the
+Prometheus text exposition format. Every subsystem registers against
+the global ``REGISTRY`` exactly as every reference crate defines a
+``metrics.rs`` against lighthouse_metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Metric:
+    def __init__(self, name: str, help_text: str, label_names=()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _label_key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != {self.label_names}"
+            )
+        return tuple(labels[k] for k in self.label_names)
+
+    @staticmethod
+    def _fmt_labels(names, values) -> str:
+        if not names:
+            return ""
+        inner = ",".join(
+            f'{n}="{v}"' for n, v in zip(names, values)
+        )
+        return "{" + inner + "}"
+
+
+class Counter(Metric):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        values = self._values or ({(): 0.0} if not self.label_names else {})
+        for key, v in sorted(values.items()):
+            lines.append(
+                f"{self.name}{self._fmt_labels(self.label_names, key)} {v}"
+            )
+        return lines
+
+
+class Gauge(Metric):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._label_key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        values = self._values or ({(): 0.0} if not self.label_names else {})
+        for key, v in sorted(values.items()):
+            lines.append(
+                f"{self.name}{self._fmt_labels(self.label_names, key)} {v}"
+            )
+        return lines
+
+
+@dataclass
+class _HistogramShard:
+    counts: list = field(default_factory=list)
+    total: float = 0.0
+    count: int = 0
+
+
+class Histogram(Metric):
+    def __init__(self, name, help_text, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._shards: dict[tuple, _HistogramShard] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            shard = self._shards.get(key)
+            if shard is None:
+                shard = _HistogramShard(counts=[0] * len(self.buckets))
+                self._shards[key] = shard
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    shard.counts[i] += 1
+            shard.total += value
+            shard.count += 1
+
+    def start_timer(self, **labels):
+        """with h.start_timer(): ...  (lighthouse_metrics start_timer)"""
+        metric = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metric.observe(time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def expose(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for key, shard in sorted(self._shards.items()):
+            base = list(zip(self.label_names, key))
+            for i, b in enumerate(self.buckets):
+                names = [n for n, _ in base] + ["le"]
+                vals = [v for _, v in base] + [repr(float(b))]
+                lines.append(
+                    f"{self.name}_bucket{self._fmt_labels(names, vals)} "
+                    f"{shard.counts[i]}"
+                )
+            names = [n for n, _ in base] + ["le"]
+            vals = [v for _, v in base] + ["+Inf"]
+            lines.append(
+                f"{self.name}_bucket{self._fmt_labels(names, vals)} {shard.count}"
+            )
+            lbl = self._fmt_labels(self.label_names, key)
+            lines.append(f"{self.name}_sum{lbl} {shard.total}")
+            lines.append(f"{self.name}_count{lbl} {shard.count}")
+        return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: Metric) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(f"metric {metric.name} type clash")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self._register(Counter(name, help_text, label_names))
+
+    def gauge(self, name, help_text="", label_names=()) -> Gauge:
+        return self._register(Gauge(name, help_text, label_names))
+
+    def histogram(self, name, help_text="", label_names=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, label_names, buckets))
+
+    def gather(self) -> str:
+        """Prometheus text exposition of everything registered."""
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].expose())
+        return "\n".join(lines) + "\n"
+
+
+#: the process-global registry (lighthouse_metrics' lazy_static)
+REGISTRY = Registry()

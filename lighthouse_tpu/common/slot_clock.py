@@ -1,0 +1,73 @@
+"""Slot clocks (reference: common/slot_clock/src/lib.rs:20-78).
+
+`SystemSlotClock` reads wall time; `ManualSlotClock` is the deterministic
+test clock the harness drives (reference: ManualSlotClock / the harness's
+TestingSlotClock)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> int | None:
+        """Current slot, or None before genesis."""
+        t = self._now_seconds()
+        if t < self.genesis_time:
+            return None
+        return int(t - self.genesis_time) // self.seconds_per_slot
+
+    def slot_of(self, timestamp: float) -> int | None:
+        if timestamp < self.genesis_time:
+            return None
+        return int(timestamp - self.genesis_time) // self.seconds_per_slot
+
+    def start_of(self, slot: int) -> float:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_from_current_slot_start(self) -> float | None:
+        t = self._now_seconds()
+        slot = self.now()
+        if slot is None:
+            return None
+        return t - self.start_of(slot)
+
+    def duration_to_next_slot(self) -> float:
+        t = self._now_seconds()
+        slot = self.slot_of(t)
+        if slot is None:
+            return self.genesis_time - t
+        return self.start_of(slot + 1) - t
+
+    def _now_seconds(self) -> float:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class SystemSlotClock(SlotClock):
+    def _now_seconds(self) -> float:
+        return time.time()
+
+
+class ManualSlotClock(SlotClock):
+    """Deterministic clock; tests advance it explicitly."""
+
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        super().__init__(genesis_time, seconds_per_slot)
+        self._time = float(genesis_time)
+
+    def _now_seconds(self) -> float:
+        return self._time
+
+    def set_slot(self, slot: int) -> None:
+        self._time = self.start_of(slot)
+
+    def advance_slot(self) -> None:
+        slot = self.now()
+        self.set_slot((slot if slot is not None else -1) + 1)
+
+    def advance_time(self, seconds: float) -> None:
+        self._time += seconds

@@ -1,0 +1,89 @@
+"""TaskExecutor — metered spawn wrappers with shutdown propagation
+(reference: common/task_executor/src/lib.rs:72-388; every async task in
+the reference goes through this).
+
+The reference wraps a tokio handle; here tasks are Python threads (the
+node's long-running services: network poll loop, slot timer, metrics
+server) with the same guarantees: every spawn is metered, a shutdown
+signal stops the loops, and ``block_on_shutdown`` joins everything.
+Deterministic tests can instead drive components directly and never
+spawn.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Callable
+
+from .metrics import REGISTRY
+
+
+class ShutdownSignal:
+    """Cooperative shutdown flag handed to every task
+    (the reference's exit-future / shutdown channel)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: str | None = None
+
+    def trigger(self, reason: str = "shutdown requested") -> None:
+        self.reason = self.reason or reason
+        self._event.set()
+
+    def is_triggered(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class TaskExecutor:
+    def __init__(self, name: str = "node"):
+        self.name = name
+        self.shutdown = ShutdownSignal()
+        self._threads: list[threading.Thread] = []
+        self._tasks_started = REGISTRY.counter(
+            "task_executor_tasks_started", "Tasks spawned", ("name",)
+        )
+        self._tasks_ended = REGISTRY.counter(
+            "task_executor_tasks_ended", "Tasks finished", ("name", "outcome")
+        )
+
+    def spawn(self, fn: Callable, name: str) -> threading.Thread:
+        """Run ``fn(shutdown)`` on a thread; a crash triggers shutdown
+        (the reference's spawn logs + signals on panic)."""
+        self._tasks_started.inc(name=name)
+
+        def runner():
+            try:
+                fn(self.shutdown)
+                self._tasks_ended.inc(name=name, outcome="ok")
+            except Exception:
+                traceback.print_exc()
+                self._tasks_ended.inc(name=name, outcome="crashed")
+                self.shutdown.trigger(f"task {name!r} crashed")
+
+        t = threading.Thread(target=runner, name=f"{self.name}/{name}", daemon=True)
+        self._threads.append(t)
+        t.start()
+        return t
+
+    def spawn_periodic(self, fn: Callable, interval: float, name: str):
+        """Run ``fn()`` every ``interval`` seconds until shutdown (the
+        slot timer / notifier pattern)."""
+
+        def loop(shutdown: ShutdownSignal):
+            while not shutdown.wait(interval):
+                fn()
+
+        return self.spawn(loop, name)
+
+    def block_on_shutdown(self, timeout: float | None = None) -> str | None:
+        """Wait for the shutdown signal, then join tasks
+        (environment/src/lib.rs:379 block_until_shutdown_requested)."""
+        self.shutdown.wait(timeout)
+        self.shutdown.trigger("block_on_shutdown timeout")
+        for t in self._threads:
+            t.join(timeout=2.0)
+        return self.shutdown.reason

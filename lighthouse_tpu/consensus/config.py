@@ -51,6 +51,8 @@ class Preset:
     TARGET_COMMITTEE_SIZE: int
     MAX_VALIDATORS_PER_COMMITTEE: int
     SHUFFLE_ROUND_COUNT: int
+    # p2p aggregation (spec: TARGET_AGGREGATORS_PER_COMMITTEE, both presets)
+    TARGET_AGGREGATORS_PER_COMMITTEE: int = 16
     HYSTERESIS_QUOTIENT: int = 4
     HYSTERESIS_DOWNWARD_MULTIPLIER: int = 1
     HYSTERESIS_UPWARD_MULTIPLIER: int = 5

@@ -152,9 +152,17 @@ def initialize_beacon_state_from_eth1(
     if spec.ALTAIR_FORK_EPOCH == 0:
         state = upgrade_to_altair(state, spec)
         state.fork.previous_version = spec.ALTAIR_FORK_VERSION
+        state.latest_block_header.body_root = (
+            t.BeaconBlockBodyAltair().hash_tree_root()
+        )
         if spec.BELLATRIX_FORK_EPOCH == 0:
             state = upgrade_to_bellatrix(state, spec)
             state.fork.previous_version = spec.BELLATRIX_FORK_VERSION
+            # genesis header advertises the empty body OF THIS FORK
+            # (spec: later-fork genesis initializers rebuild body_root)
+            state.latest_block_header.body_root = (
+                t.BeaconBlockBodyBellatrix().hash_tree_root()
+            )
             if execution_payload_header is not None:
                 state.latest_execution_payload_header = execution_payload_header
     return state

@@ -208,13 +208,33 @@ def compute_proposer_index(
 
 
 def get_beacon_proposer_index(state, spec: ChainSpec) -> int:
+    return get_beacon_proposer_index_at_slot(state, int(state.slot), spec)
+
+
+def get_beacon_proposer_index_at_slot(state, slot: int, spec: ChainSpec) -> int:
+    """Proposer for any ``slot`` in the state's current epoch (the
+    proposer shuffling is epoch-stable; reference: the per-slot loop in
+    BeaconProposerCache / beacon_state.rs get_beacon_proposer_index)."""
     epoch = get_current_epoch(state, spec)
+    if compute_epoch_at_slot(slot, spec) != epoch:
+        raise ValueError("slot outside the state's current epoch")
     seed = hash_bytes(
         get_seed(state, epoch, spec.DOMAIN_BEACON_PROPOSER, spec)
-        + state.slot.to_bytes(8, "little")
+        + int(slot).to_bytes(8, "little")
     )
     indices = get_active_validator_indices(state, epoch)
     return compute_proposer_index(state, indices, seed, spec)
+
+
+def is_aggregator(committee_length: int, selection_proof: bytes,
+                  spec: ChainSpec) -> bool:
+    """Spec is_aggregator: the selection proof elects
+    ~TARGET_AGGREGATORS_PER_COMMITTEE members of the committee."""
+    modulo = max(
+        1, committee_length // spec.preset.TARGET_AGGREGATORS_PER_COMMITTEE
+    )
+    digest = hash_bytes(selection_proof)
+    return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
 def get_attesting_indices(

@@ -1,0 +1,37 @@
+"""Merkle branch generation/verification (reference:
+consensus/merkle_proof, 442 LoC — deposit proofs are the main user).
+
+``is_valid_merkle_branch`` is the spec predicate used by
+process_deposit; ``merkle_root_from_branch`` recomputes the root for
+diagnostics; ``MerkleTree.generate_proof``-equivalent construction
+lives in consensus/deposit_tree.py (the incremental tree).
+"""
+
+from __future__ import annotations
+
+from .hashing import hash_bytes
+
+
+def hash32_concat(a: bytes, b: bytes) -> bytes:
+    return hash_bytes(a + b)
+
+
+def merkle_root_from_branch(
+    leaf: bytes, branch: list[bytes], depth: int, index: int
+) -> bytes:
+    """Fold the branch bottom-up (spec is_valid_merkle_branch body)."""
+    node = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            node = hash32_concat(branch[i], node)
+        else:
+            node = hash32_concat(node, branch[i])
+    return node
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch: list[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    if len(branch) < depth:
+        return False
+    return merkle_root_from_branch(leaf, branch, depth, index) == root

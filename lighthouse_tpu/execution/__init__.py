@@ -1,0 +1,32 @@
+"""Execution layer + eth1 (reference: beacon_node/execution_layer 5.7k
+LoC + beacon_node/eth1 3.4k LoC + builder_client).
+
+* ``engine_api``       — Engine-API JSON-RPC client with JWT (HS256)
+  auth: new_payload/forkchoice_updated/get_payload/exchange_transition_
+  configuration (engine_api/http.rs:31-41, auth.rs).
+* ``execution_layer``  — ExecutionLayer façade: multi-engine fallback,
+  payload status classification, payload building for proposals
+  (lib.rs, engines.rs, payload_status.rs).
+* ``mock``             — MockExecutionServer + ExecutionBlockGenerator:
+  an in-process engine-API HTTP server over a fake EL chain
+  (execution_layer/src/test_utils/), the fixture every merge test runs
+  against.
+* ``eth1``             — deposit-contract follower: BlockCache +
+  DepositCache (incremental deposit Merkle tree) + eth1-data voting
+  (eth1/src/service.rs:497).
+"""
+
+from .engine_api import EngineApiClient, JwtAuth, PayloadStatus
+from .eth1 import Eth1Service
+from .execution_layer import ExecutionLayer
+from .mock import ExecutionBlockGenerator, MockExecutionServer
+
+__all__ = [
+    "EngineApiClient",
+    "Eth1Service",
+    "ExecutionBlockGenerator",
+    "ExecutionLayer",
+    "JwtAuth",
+    "MockExecutionServer",
+    "PayloadStatus",
+]

@@ -1,0 +1,128 @@
+"""Engine-API JSON-RPC client (reference: execution_layer/src/engine_api/
+http.rs:31-41 + auth.rs).
+
+Speaks `engine_newPayloadV1`, `engine_forkchoiceUpdatedV1`,
+`engine_getPayloadV1`, `engine_exchangeTransitionConfigurationV1` and
+the eth1-follower methods (`eth_blockNumber`, `eth_getBlockByNumber`,
+`eth_getLogs`) over HTTP JSON-RPC with JWT bearer auth — the HS256
+token construction the engine API mandates (auth.rs JWT claims: iat).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.request
+from enum import Enum
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+class JwtAuth:
+    """HS256 JWT signer over the shared secret (auth.rs)."""
+
+    def __init__(self, secret: bytes):
+        if len(secret) != 32:
+            raise ValueError("jwt secret must be 32 bytes")
+        self.secret = secret
+
+    def token(self, now: float | None = None) -> str:
+        header = _b64url(json.dumps({"typ": "JWT", "alg": "HS256"}).encode())
+        claims = _b64url(
+            json.dumps({"iat": int(now if now is not None else time.time())}).encode()
+        )
+        signing_input = f"{header}.{claims}".encode()
+        sig = hmac.new(self.secret, signing_input, hashlib.sha256).digest()
+        return f"{header}.{claims}.{_b64url(sig)}"
+
+    def validate(self, token: str, now: float | None = None,
+                 drift: float = 60.0) -> bool:
+        try:
+            header, claims, sig = token.split(".")
+            expect = hmac.new(
+                self.secret, f"{header}.{claims}".encode(), hashlib.sha256
+            ).digest()
+            if not hmac.compare_digest(_b64url(expect), sig):
+                return False
+            pad = "=" * (-len(claims) % 4)
+            iat = json.loads(base64.urlsafe_b64decode(claims + pad))["iat"]
+            t = now if now is not None else time.time()
+            return abs(t - iat) <= drift
+        except (ValueError, KeyError):
+            return False
+
+
+class PayloadStatus(str, Enum):
+    """engine_newPayload / forkchoiceUpdated statuses
+    (payload_status.rs)."""
+
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+
+
+class EngineApiError(Exception):
+    pass
+
+
+class EngineApiClient:
+    def __init__(self, url: str, jwt: JwtAuth | None = None, timeout: float = 8.0):
+        self.url = url
+        self.jwt = jwt
+        self.timeout = timeout
+        self._id = 0
+
+    # ------------------------------------------------------------- transport
+    def _call(self, method: str, params: list):
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.jwt is not None:
+            headers["Authorization"] = f"Bearer {self.jwt.token()}"
+        req = urllib.request.Request(self.url, data=body, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except OSError as e:
+            raise EngineApiError(f"engine unreachable: {e}") from None
+        if "error" in payload and payload["error"]:
+            raise EngineApiError(str(payload["error"]))
+        return payload.get("result")
+
+    # --------------------------------------------------------------- engine
+    def new_payload_v1(self, execution_payload_json: dict) -> dict:
+        """engine_newPayloadV1 (http.rs:642)."""
+        return self._call("engine_newPayloadV1", [execution_payload_json])
+
+    def forkchoice_updated_v1(self, forkchoice_state: dict,
+                              payload_attributes: dict | None = None) -> dict:
+        """engine_forkchoiceUpdatedV1 (http.rs:668)."""
+        return self._call(
+            "engine_forkchoiceUpdatedV1", [forkchoice_state, payload_attributes]
+        )
+
+    def get_payload_v1(self, payload_id: str) -> dict:
+        return self._call("engine_getPayloadV1", [payload_id])
+
+    def exchange_transition_configuration_v1(self, config: dict) -> dict:
+        return self._call("engine_exchangeTransitionConfigurationV1", [config])
+
+    # ----------------------------------------------------------------- eth1
+    def block_number(self) -> int:
+        return int(self._call("eth_blockNumber", []), 16)
+
+    def get_block_by_number(self, number: int | str, full: bool = False) -> dict:
+        tag = hex(number) if isinstance(number, int) else number
+        return self._call("eth_getBlockByNumber", [tag, full])
+
+    def get_logs(self, filter_obj: dict) -> list:
+        return self._call("eth_getLogs", [filter_obj])

@@ -1,0 +1,140 @@
+"""Eth1 deposit-contract follower (reference: beacon_node/eth1/src/
+service.rs:497 + block_cache.rs + deposit_cache.rs).
+
+Polls an execution node's JSON-RPC for blocks and deposit logs,
+maintains:
+
+* ``BlockCache``   — recent eth1 blocks (hash, number, timestamp) for
+  eth1-data voting;
+* ``DepositCache`` — every deposit event in order, mirrored into the
+  incremental deposit Merkle tree so `deposit_root`/`deposit_count`
+  and inclusion proofs come straight off it.
+
+``eth1_data_for_block_production`` implements the voting rule: follow
+distance back from the head, majority vote among the current period's
+state votes, else the freshest eligible block (eth1_chain.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..consensus.deposit_tree import DepositTree
+from ..consensus.types import Eth1Data
+from .engine_api import EngineApiClient, EngineApiError
+
+
+@dataclass
+class Eth1Block:
+    hash: bytes
+    parent_hash: bytes
+    number: int
+    timestamp: int
+    deposit_root: bytes | None = None
+    deposit_count: int = 0
+
+
+class DepositCache:
+    """Ordered deposit log cache + incremental tree (deposit_cache.rs)."""
+
+    def __init__(self):
+        self.tree = DepositTree()
+        self.deposits: list[dict] = []  # raw log entries, index-ordered
+
+    def insert_log(self, log: dict) -> None:
+        index = int(log["index"])
+        if index != len(self.deposits):
+            if index < len(self.deposits):
+                return  # duplicate
+            raise ValueError(
+                f"non-contiguous deposit log {index} (have {len(self.deposits)})"
+            )
+        self.deposits.append(log)
+        self.tree.push_leaf(bytes.fromhex(log["data_root"].removeprefix("0x")))
+
+    def count(self) -> int:
+        return len(self.deposits)
+
+    def root(self) -> bytes:
+        return self.tree.root()
+
+    def proof(self, index: int) -> list[bytes]:
+        return self.tree.proof(index)
+
+
+class Eth1Service:
+    def __init__(self, client: EngineApiClient, spec, cache_len: int = 1024):
+        self.client = client
+        self.spec = spec
+        self.cache_len = cache_len
+        self.blocks: dict[int, Eth1Block] = {}  # by number
+        self.deposit_cache = DepositCache()
+        self.highest_block: int = -1
+
+    # ---------------------------------------------------------------- update
+    def update(self) -> int:
+        """One poll round (service.rs update_block_cache +
+        update_deposit_cache). Returns new blocks fetched."""
+        try:
+            head = self.client.block_number()
+        except EngineApiError:
+            return 0
+        fetched = 0
+        start = max(0, self.highest_block + 1, head - self.cache_len + 1)
+        for number in range(start, head + 1):
+            raw = self.client.get_block_by_number(number)
+            if raw is None:
+                break
+            self.blocks[number] = Eth1Block(
+                hash=bytes.fromhex(raw["hash"].removeprefix("0x")),
+                parent_hash=bytes.fromhex(raw["parentHash"].removeprefix("0x")),
+                number=int(raw["number"], 16),
+                timestamp=int(raw["timestamp"], 16),
+            )
+            self.highest_block = number
+            fetched += 1
+        # deposit logs
+        try:
+            logs = self.client.get_logs(
+                {"fromBlock": hex(0), "toBlock": hex(max(head, 0))}
+            )
+        except EngineApiError:
+            logs = []
+        for log in logs:
+            if int(log["index"]) >= self.deposit_cache.count():
+                self.deposit_cache.insert_log(log)
+        # prune old blocks
+        if len(self.blocks) > self.cache_len:
+            for n in sorted(self.blocks)[: len(self.blocks) - self.cache_len]:
+                del self.blocks[n]
+        return fetched
+
+    # ----------------------------------------------------------- eth1 voting
+    def eth1_data_for_block_production(self, state, spec) -> Eth1Data:
+        """eth1_chain.rs: majority vote in the current voting period if
+        any, else the block ETH1_FOLLOW_DISTANCE behind the head, else
+        the state's existing eth1_data."""
+        votes = list(state.eth1_data_votes)
+        if votes:
+            tally: dict[bytes, tuple[int, object]] = {}
+            for v in votes:
+                key = v.hash_tree_root()
+                count, _ = tally.get(key, (0, v))
+                tally[key] = (count + 1, v)
+            best_key = max(tally, key=lambda k: tally[k][0])
+            count, best = tally[best_key]
+            if count * 2 > len(votes):
+                return Eth1Data(
+                    deposit_root=bytes(best.deposit_root),
+                    deposit_count=int(best.deposit_count),
+                    block_hash=bytes(best.block_hash),
+                )
+        target = self.highest_block - spec.ETH1_FOLLOW_DISTANCE
+        block = self.blocks.get(target)
+        if block is None:
+            return state.eth1_data
+        return Eth1Data(
+            deposit_root=self.deposit_cache.root(),
+            deposit_count=self.deposit_cache.count(),
+            block_hash=block.hash,
+        )

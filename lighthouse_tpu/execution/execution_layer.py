@@ -1,0 +1,144 @@
+"""ExecutionLayer façade (reference: execution_layer/src/lib.rs +
+engines.rs + payload_status.rs).
+
+Owns one-or-more engine endpoints with failover, classifies payload
+statuses into the chain's ExecutionStatus vocabulary, notifies new
+payloads and forkchoice updates, and builds payloads for proposals
+(the getPayload round-trip with payload attributes).
+"""
+
+from __future__ import annotations
+
+from ..forkchoice import ExecutionStatus
+from .engine_api import EngineApiClient, EngineApiError, PayloadStatus
+
+
+def payload_to_engine_json(payload) -> dict:
+    """SSZ ExecutionPayload → engine-API camelCase JSON
+    (engine_api/json_structures.rs)."""
+    return {
+        "parentHash": "0x" + bytes(payload.parent_hash).hex(),
+        "feeRecipient": "0x" + bytes(payload.fee_recipient).hex(),
+        "stateRoot": "0x" + bytes(payload.state_root).hex(),
+        "receiptsRoot": "0x" + bytes(payload.receipts_root).hex(),
+        "logsBloom": "0x" + bytes(payload.logs_bloom).hex(),
+        "prevRandao": "0x" + bytes(payload.prev_randao).hex(),
+        "blockNumber": hex(int(payload.block_number)),
+        "gasLimit": hex(int(payload.gas_limit)),
+        "gasUsed": hex(int(payload.gas_used)),
+        "timestamp": hex(int(payload.timestamp)),
+        "extraData": "0x" + bytes(payload.extra_data).hex(),
+        "baseFeePerGas": hex(int(payload.base_fee_per_gas)),
+        "blockHash": "0x" + bytes(payload.block_hash).hex(),
+        "transactions": ["0x" + bytes(t).hex() for t in payload.transactions],
+    }
+
+
+def engine_json_to_payload(types, data: dict):
+    """Engine-API JSON → SSZ ExecutionPayload (proposal path)."""
+
+    def b(key):
+        return bytes.fromhex(data[key].removeprefix("0x"))
+
+    return types.ExecutionPayload(
+        parent_hash=b("parentHash"),
+        fee_recipient=b("feeRecipient"),
+        state_root=b("stateRoot"),
+        receipts_root=b("receiptsRoot"),
+        logs_bloom=b("logsBloom"),
+        prev_randao=b("prevRandao"),
+        block_number=int(data["blockNumber"], 16),
+        gas_limit=int(data["gasLimit"], 16),
+        gas_used=int(data["gasUsed"], 16),
+        timestamp=int(data["timestamp"], 16),
+        extra_data=b("extraData"),
+        base_fee_per_gas=int(data["baseFeePerGas"], 16),
+        block_hash=b("blockHash"),
+        transactions=[
+            bytes.fromhex(t.removeprefix("0x")) for t in data["transactions"]
+        ],
+    )
+
+
+class ExecutionLayer:
+    def __init__(self, engines: list[EngineApiClient]):
+        if not engines:
+            raise ValueError("at least one engine required")
+        self.engines = list(engines)
+        self._primary = 0
+        self.stats = {"new_payloads": 0, "forkchoice_updates": 0, "failovers": 0}
+
+    # -------------------------------------------------------------- failover
+    def _walk(self, op):
+        """Try engines starting from the last-good one (engines.rs
+        state machine, condensed)."""
+        last: Exception | None = None
+        n = len(self.engines)
+        for off in range(n):
+            i = (self._primary + off) % n
+            try:
+                out = op(self.engines[i])
+                if i != self._primary:
+                    self._primary = i
+                    self.stats["failovers"] += 1
+                return out
+            except EngineApiError as e:
+                last = e
+        raise EngineApiError(f"all engines failed: {last}")
+
+    # ------------------------------------------------------------- payloads
+    def notify_new_payload(self, payload_json: dict) -> ExecutionStatus:
+        """newPayload → chain ExecutionStatus (lib.rs notify_new_payload
+        + payload_status.rs mapping)."""
+        self.stats["new_payloads"] += 1
+        result = self._walk(lambda e: e.new_payload_v1(payload_json))
+        status = PayloadStatus(result["status"])
+        if status == PayloadStatus.VALID:
+            return ExecutionStatus.VALID
+        if status in (PayloadStatus.INVALID, PayloadStatus.INVALID_BLOCK_HASH):
+            return ExecutionStatus.INVALID
+        return ExecutionStatus.OPTIMISTIC  # SYNCING / ACCEPTED
+
+    def notify_forkchoice_updated(
+        self,
+        head_block_hash: bytes,
+        finalized_block_hash: bytes,
+        payload_attributes: dict | None = None,
+    ):
+        """forkchoiceUpdated; returns (ExecutionStatus, payload_id)."""
+        self.stats["forkchoice_updates"] += 1
+        state = {
+            "headBlockHash": "0x" + head_block_hash.hex(),
+            "safeBlockHash": "0x" + head_block_hash.hex(),
+            "finalizedBlockHash": "0x" + finalized_block_hash.hex(),
+        }
+        result = self._walk(
+            lambda e: e.forkchoice_updated_v1(state, payload_attributes)
+        )
+        status = PayloadStatus(result["payloadStatus"]["status"])
+        mapped = (
+            ExecutionStatus.VALID
+            if status == PayloadStatus.VALID
+            else ExecutionStatus.INVALID
+            if status == PayloadStatus.INVALID
+            else ExecutionStatus.OPTIMISTIC
+        )
+        return mapped, result.get("payloadId")
+
+    def get_payload(self, payload_id: str) -> dict:
+        return self._walk(lambda e: e.get_payload_v1(payload_id))
+
+    def exchange_transition_configuration(self, ttd: int,
+                                          terminal_block_hash: bytes) -> bool:
+        config = {
+            "terminalTotalDifficulty": hex(ttd),
+            "terminalBlockHash": "0x" + terminal_block_hash.hex(),
+            "terminalBlockNumber": "0x0",
+        }
+        try:
+            echo = self._walk(
+                lambda e: e.exchange_transition_configuration_v1(config)
+            )
+        except EngineApiError:
+            return False
+        return echo.get("terminalTotalDifficulty") == config["terminalTotalDifficulty"]

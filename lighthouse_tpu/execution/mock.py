@@ -1,0 +1,253 @@
+"""Mock execution layer (reference: execution_layer/src/test_utils/ —
+MockServer + ExecutionBlockGenerator).
+
+``ExecutionBlockGenerator`` maintains a fake EL chain: PoW blocks up to
+a configurable terminal total difficulty, then PoS blocks inserted via
+new_payload/forkchoiceUpdated. ``MockExecutionServer`` exposes it over
+real HTTP JSON-RPC with JWT auth — the node's EngineApiClient talks to
+it exactly as it would to geth (the reference boots the same pair in
+every merge test).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..consensus.hashing import hash_bytes
+from .engine_api import JwtAuth, PayloadStatus
+
+
+@dataclass
+class PowBlock:
+    block_hash: bytes
+    parent_hash: bytes
+    number: int
+    total_difficulty: int
+    timestamp: int
+
+
+@dataclass
+class ExecutionBlockGenerator:
+    """The fake EL chain (test_utils/execution_block_generator.rs)."""
+
+    terminal_total_difficulty: int = 0
+    difficulty_per_block: int = 1
+    blocks: dict[bytes, PowBlock] = field(default_factory=dict)
+    payloads: dict[bytes, dict] = field(default_factory=dict)
+    head_hash: bytes = b"\x00" * 32
+    head_number: int = -1
+    finalized_hash: bytes = b"\x00" * 32
+    _payload_counter: int = 0
+    pending_payloads: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.head_number < 0:
+            self.insert_pow_block()  # genesis EL block
+
+    # ------------------------------------------------------------ PoW phase
+    def insert_pow_block(self) -> PowBlock:
+        number = self.head_number + 1
+        parent = self.head_hash if number > 0 else b"\x00" * 32
+        parent_td = (
+            self.blocks[parent].total_difficulty if parent in self.blocks else 0
+        )
+        block_hash = hash_bytes(b"pow" + number.to_bytes(8, "little") + parent)
+        block = PowBlock(
+            block_hash=block_hash,
+            parent_hash=parent,
+            number=number,
+            total_difficulty=parent_td + self.difficulty_per_block,
+            timestamp=number * 12,
+        )
+        self.blocks[block_hash] = block
+        self.head_hash, self.head_number = block_hash, number
+        return block
+
+    def terminal_block(self) -> PowBlock | None:
+        for b in self.blocks.values():
+            if b.total_difficulty >= self.terminal_total_difficulty:
+                return b
+        return None
+
+    # ------------------------------------------------------------ PoS phase
+    def new_payload(self, payload: dict) -> dict:
+        parent = bytes.fromhex(payload["parentHash"].removeprefix("0x"))
+        block_hash = bytes.fromhex(payload["blockHash"].removeprefix("0x"))
+        expected = self.compute_block_hash(payload)
+        if block_hash != expected:
+            return {"status": PayloadStatus.INVALID_BLOCK_HASH.value,
+                    "latestValidHash": None, "validationError": "hash"}
+        if parent not in self.blocks and parent not in self.payloads:
+            return {"status": PayloadStatus.SYNCING.value,
+                    "latestValidHash": None, "validationError": None}
+        self.payloads[block_hash] = payload
+        return {"status": PayloadStatus.VALID.value,
+                "latestValidHash": "0x" + block_hash.hex(),
+                "validationError": None}
+
+    def forkchoice_updated(self, state: dict, attributes: dict | None) -> dict:
+        head = bytes.fromhex(state["headBlockHash"].removeprefix("0x"))
+        if head not in self.blocks and head not in self.payloads:
+            return {
+                "payloadStatus": {"status": PayloadStatus.SYNCING.value,
+                                  "latestValidHash": None,
+                                  "validationError": None},
+                "payloadId": None,
+            }
+        self.head_hash = head
+        self.finalized_hash = bytes.fromhex(
+            state["finalizedBlockHash"].removeprefix("0x")
+        )
+        payload_id = None
+        if attributes is not None:
+            self._payload_counter += 1
+            payload_id = "0x" + self._payload_counter.to_bytes(8, "big").hex()
+            self.pending_payloads[payload_id] = self._build_payload(head, attributes)
+        return {
+            "payloadStatus": {"status": PayloadStatus.VALID.value,
+                              "latestValidHash": "0x" + head.hex(),
+                              "validationError": None},
+            "payloadId": payload_id,
+        }
+
+    def get_payload(self, payload_id: str) -> dict | None:
+        return self.pending_payloads.get(payload_id)
+
+    def _build_payload(self, parent: bytes, attributes: dict) -> dict:
+        number = (
+            self.blocks[parent].number + 1
+            if parent in self.blocks
+            else int(self.payloads[parent]["blockNumber"], 16) + 1
+        )
+        payload = {
+            "parentHash": "0x" + parent.hex(),
+            "feeRecipient": attributes.get(
+                "suggestedFeeRecipient", "0x" + "00" * 20
+            ),
+            "stateRoot": "0x" + hash_bytes(b"state" + parent).hex(),
+            "receiptsRoot": "0x" + hash_bytes(b"rcpt" + parent).hex(),
+            "logsBloom": "0x" + "00" * 256,
+            "prevRandao": attributes.get("prevRandao", "0x" + "00" * 32),
+            "blockNumber": hex(number),
+            "gasLimit": hex(30_000_000),
+            "gasUsed": hex(0),
+            "timestamp": attributes.get("timestamp", hex(number * 12)),
+            "extraData": "0x",
+            "baseFeePerGas": hex(7),
+            "transactions": [],
+        }
+        payload["blockHash"] = "0x" + self.compute_block_hash(payload).hex()
+        return payload
+
+    @staticmethod
+    def compute_block_hash(payload: dict) -> bytes:
+        """Deterministic fake EL block hash over the payload fields."""
+        material = json.dumps(
+            {k: v for k, v in sorted(payload.items()) if k != "blockHash"},
+            sort_keys=True,
+        ).encode()
+        return hash_bytes(material)
+
+    # -------------------------------------------------------------- queries
+    def block_by_number_json(self, number: int) -> dict | None:
+        for b in self.blocks.values():
+            if b.number == number:
+                return self._pow_json(b)
+        for p in self.payloads.values():
+            if int(p["blockNumber"], 16) == number:
+                return {"hash": p["blockHash"],
+                        "parentHash": p["parentHash"],
+                        "number": p["blockNumber"],
+                        "totalDifficulty": hex(self.terminal_total_difficulty),
+                        "timestamp": p["timestamp"]}
+        return None
+
+    def _pow_json(self, b: PowBlock) -> dict:
+        return {
+            "hash": "0x" + b.block_hash.hex(),
+            "parentHash": "0x" + b.parent_hash.hex(),
+            "number": hex(b.number),
+            "totalDifficulty": hex(b.total_difficulty),
+            "timestamp": hex(b.timestamp),
+        }
+
+
+class MockExecutionServer:
+    """Engine-API + eth1 JSON-RPC over real HTTP (test_utils/mock_server)."""
+
+    def __init__(self, generator: ExecutionBlockGenerator | None = None,
+                 jwt_secret: bytes | None = None, port: int = 0):
+        self.generator = generator or ExecutionBlockGenerator()
+        self.jwt = JwtAuth(jwt_secret) if jwt_secret is not None else None
+        self.deposit_logs: list[dict] = []  # eth1 deposit events
+        gen = self.generator
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if server_ref.jwt is not None:
+                    auth = self.headers.get("Authorization", "")
+                    token = auth.removeprefix("Bearer ").strip()
+                    if not server_ref.jwt.validate(token):
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                length = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(length))
+                result = server_ref._dispatch(req["method"], req.get("params", []))
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def _dispatch(self, method: str, params: list):
+        gen = self.generator
+        if method == "engine_newPayloadV1":
+            return gen.new_payload(params[0])
+        if method == "engine_forkchoiceUpdatedV1":
+            return gen.forkchoice_updated(params[0], params[1])
+        if method == "engine_getPayloadV1":
+            return gen.get_payload(params[0])
+        if method == "engine_exchangeTransitionConfigurationV1":
+            return params[0]  # echo = agreement
+        if method == "eth_blockNumber":
+            return hex(gen.head_number)
+        if method == "eth_getBlockByNumber":
+            tag = params[0]
+            number = gen.head_number if tag == "latest" else int(tag, 16)
+            return gen.block_by_number_json(number)
+        if method == "eth_getLogs":
+            filt = params[0]
+            lo = int(filt.get("fromBlock", "0x0"), 16)
+            hi = int(filt.get("toBlock", hex(gen.head_number)), 16)
+            return [
+                log for log in self.deposit_logs
+                if lo <= int(log["blockNumber"], 16) <= hi
+            ]
+        raise ValueError(f"unknown method {method}")
+
+    def start(self) -> "MockExecutionServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -479,6 +479,22 @@ class ProtoArrayForkChoice:
             cursor = self.proto_array.nodes[cursor].parent
         return False
 
+    def ancestor_at_slot(self, root: bytes, slot: int) -> bytes | None:
+        """Highest ancestor of ``root`` with node.slot <= slot (the
+        get_ancestor walk used for shuffling decision roots). If pruning
+        removed history past ``slot``, the oldest retained ancestor (the
+        finalized anchor) is returned — every canonical block at or
+        below the finalized slot resolves to it."""
+        cursor = self.proto_array.indices.get(root)
+        last = None
+        while cursor is not None:
+            node = self.proto_array.nodes[cursor]
+            if node.slot <= slot:
+                return node.root
+            last = node
+            cursor = node.parent
+        return last.root if last is not None else None
+
     def latest_message(self, validator_index: int) -> tuple[bytes, int] | None:
         if validator_index < len(self.votes):
             v = self.votes[validator_index]

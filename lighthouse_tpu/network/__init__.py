@@ -1,0 +1,66 @@
+"""Networking stack (reference: beacon_node/lighthouse_network +
+beacon_node/network, ~36k LoC Rust).
+
+The reference wraps rust-libp2p (gossipsub + req/resp RPC + discv5) and
+bridges it to the chain through a prioritized work scheduler
+(``BeaconProcessor``). This package rebuilds that capability surface for
+the TPU-native node:
+
+* ``snappy``    — pure-Python snappy block codec (wire compression; the
+  reference links the C `snap` crate).
+* ``gossip``    — topic naming (fork-digest scoped), message ids, pubsub
+  message encode/decode (types/pubsub.rs).
+* ``rpc``       — req/resp protocols (Status, Goodbye, BlocksByRange,
+  BlocksByRoot, Ping, Metadata) with ssz_snappy codec and token-bucket
+  rate limiting (rpc/{protocol,codec,rate_limiter}.rs).
+* ``peer_manager`` — peer scoring/banning (peer_manager/peerdb.rs).
+* ``transport`` — the swarm: an in-process deterministic mesh hub for
+  tests/simulation (the libp2p Swarm seam; service.rs).
+* ``processor`` — the BeaconProcessor: bounded prioritized queues with
+  TPU-sized opportunistic batch coalescing (beacon_processor/mod.rs).
+* ``router``    — message classification gossip/RPC → work events
+  (router/mod.rs).
+* ``sync``      — range sync / backfill / parent lookups (sync/manager.rs).
+* ``service``   — NetworkService wiring all of the above to a BeaconChain.
+"""
+
+from .gossip import GossipTopic, PubsubMessage
+from .processor import BeaconProcessor, WorkEvent, WorkType
+from .peer_manager import PeerAction, PeerManager
+from .rpc import (
+    BlocksByRangeRequest,
+    BlocksByRootRequest,
+    GoodbyeReason,
+    MetadataResponse,
+    PingData,
+    RateLimiter,
+    RpcError,
+    StatusMessage,
+)
+from .router import Router
+from .service import NetworkService
+from .sync import SyncManager
+from .transport import InMemoryHub, Peer
+
+__all__ = [
+    "BeaconProcessor",
+    "BlocksByRangeRequest",
+    "BlocksByRootRequest",
+    "GoodbyeReason",
+    "GossipTopic",
+    "InMemoryHub",
+    "MetadataResponse",
+    "NetworkService",
+    "Peer",
+    "PeerAction",
+    "PeerManager",
+    "PingData",
+    "PubsubMessage",
+    "RateLimiter",
+    "Router",
+    "RpcError",
+    "StatusMessage",
+    "SyncManager",
+    "WorkEvent",
+    "WorkType",
+]

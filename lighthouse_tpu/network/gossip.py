@@ -1,0 +1,138 @@
+"""Gossip topics and pubsub message codec.
+
+Capability mirror of `lighthouse_network/src/types/{topics,pubsub}.rs`:
+topic strings are fork-digest scoped
+(``/eth2/{fork_digest_hex}/{kind}/ssz_snappy``), payloads are
+SSZ-encoded then snappy-compressed, and message ids are
+``SHA256(MESSAGE_DOMAIN_VALID_SNAPPY ++ uncompressed_data)[:20]`` per
+the eth2 gossipsub spec — ids are content-addressed so duplicate
+delivery dedups across peers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..consensus.hashing import hash_bytes
+from . import snappy
+
+MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+
+# topic kinds (types/topics.rs)
+BEACON_BLOCK = "beacon_block"
+BEACON_AGGREGATE_AND_PROOF = "beacon_aggregate_and_proof"
+BEACON_ATTESTATION_PREFIX = "beacon_attestation_"  # + subnet id
+VOLUNTARY_EXIT = "voluntary_exit"
+PROPOSER_SLASHING = "proposer_slashing"
+ATTESTER_SLASHING = "attester_slashing"
+SYNC_COMMITTEE_PREFIX = "sync_committee_"  # + subnet id
+SYNC_CONTRIBUTION_AND_PROOF = "sync_committee_contribution_and_proof"
+
+CORE_TOPICS = (
+    BEACON_BLOCK,
+    BEACON_AGGREGATE_AND_PROOF,
+    VOLUNTARY_EXIT,
+    PROPOSER_SLASHING,
+    ATTESTER_SLASHING,
+    SYNC_CONTRIBUTION_AND_PROOF,
+)
+
+ATTESTATION_SUBNET_COUNT = 64
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+@dataclass(frozen=True)
+class GossipTopic:
+    fork_digest: bytes  # 4 bytes
+    kind: str
+
+    def __str__(self) -> str:
+        return f"/eth2/{self.fork_digest.hex()}/{self.kind}/ssz_snappy"
+
+    @classmethod
+    def parse(cls, s: str) -> "GossipTopic":
+        parts = s.split("/")
+        if len(parts) != 5 or parts[1] != "eth2" or parts[4] != "ssz_snappy":
+            raise ValueError(f"unparseable gossip topic: {s!r}")
+        return cls(bytes.fromhex(parts[2]), parts[3])
+
+    @classmethod
+    def attestation_subnet(cls, fork_digest: bytes, subnet_id: int) -> "GossipTopic":
+        return cls(fork_digest, f"{BEACON_ATTESTATION_PREFIX}{subnet_id}")
+
+    @classmethod
+    def sync_subnet(cls, fork_digest: bytes, subnet_id: int) -> "GossipTopic":
+        return cls(fork_digest, f"{SYNC_COMMITTEE_PREFIX}{subnet_id}")
+
+    def subnet_id(self) -> int | None:
+        for prefix in (BEACON_ATTESTATION_PREFIX, SYNC_COMMITTEE_PREFIX):
+            if self.kind.startswith(prefix) and self.kind != SYNC_CONTRIBUTION_AND_PROOF:
+                try:
+                    return int(self.kind[len(prefix):])
+                except ValueError:
+                    return None
+        return None
+
+
+def compute_subnet_for_attestation(spec, state_slot_committees: int, slot: int, committee_index: int) -> int:
+    """spec compute_subnet_for_attestation: slot/committee → subnet."""
+    p = spec.preset
+    slots_since_epoch_start = slot % p.SLOTS_PER_EPOCH
+    committees_since_epoch_start = state_slot_committees * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) % ATTESTATION_SUBNET_COUNT
+
+
+def message_id(uncompressed_payload: bytes) -> bytes:
+    """Content-addressed gossip message id (gossipsub_scoring_parameters /
+    eth2 gossip spec: 20-byte SHA256 prefix over domain ++ payload)."""
+    return hash_bytes(MESSAGE_DOMAIN_VALID_SNAPPY + uncompressed_payload)[:20]
+
+
+class PubsubMessage:
+    """Typed gossip payload ↔ wire bytes (types/pubsub.rs:19-36).
+
+    ``kind`` is the topic kind; ``item`` the SSZ container. Decode is
+    topic-directed (the topic tells us the SSZ type), exactly like the
+    reference's `PubsubMessage::decode`.
+    """
+
+    __slots__ = ("kind", "item")
+
+    def __init__(self, kind: str, item):
+        self.kind = kind
+        self.item = item
+
+    # -- encode -------------------------------------------------------------
+    def encode(self) -> bytes:
+        return snappy.compress(self.item.encode())
+
+    @staticmethod
+    def decode(topic: GossipTopic, wire: bytes, types, fork: str):
+        """Decode ``wire`` for ``topic``. ``types`` is the spec_types
+        namespace; ``fork`` selects the block class."""
+        raw = snappy.decompress(wire)
+        kind = topic.kind
+        if kind == BEACON_BLOCK:
+            item = types.SIGNED_BLOCK_BY_FORK[fork].decode(raw)
+        elif kind == BEACON_AGGREGATE_AND_PROOF:
+            item = types.SignedAggregateAndProof.decode(raw)
+        elif kind.startswith(BEACON_ATTESTATION_PREFIX):
+            item = types.Attestation.decode(raw)
+        elif kind == VOLUNTARY_EXIT:
+            from ..consensus.types import SignedVoluntaryExit
+
+            item = SignedVoluntaryExit.decode(raw)
+        elif kind == PROPOSER_SLASHING:
+            from ..consensus.types import ProposerSlashing
+
+            item = ProposerSlashing.decode(raw)
+        elif kind == ATTESTER_SLASHING:
+            item = types.AttesterSlashing.decode(raw)
+        elif kind == SYNC_CONTRIBUTION_AND_PROOF:
+            item = types.SignedContributionAndProof.decode(raw)
+        elif kind.startswith(SYNC_COMMITTEE_PREFIX):
+            item = types.SyncCommitteeMessage.decode(raw)
+        else:
+            raise ValueError(f"unknown gossip topic kind {kind!r}")
+        return PubsubMessage(kind, item)

@@ -1,0 +1,133 @@
+"""Peer scoring / banning (reference: lighthouse_network/src/peer_manager/).
+
+The reference's `PeerDB` keeps a real-valued score per peer; gossip and
+RPC behaviors adjust it (`peerdb/score.rs`): scores decay toward zero,
+dipping below -20 disconnects, below -50 bans. ``PeerAction`` mirrors
+`peer_manager/mod.rs` (Fatal / LowToleranceError / MidToleranceError /
+HighToleranceError).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+MIN_SCORE_BEFORE_DISCONNECT = -20.0
+MIN_SCORE_BEFORE_BAN = -50.0
+HALFLIFE_SECS = 600.0
+
+
+class PeerAction(Enum):
+    FATAL = "fatal"                       # instant ban
+    LOW_TOLERANCE_ERROR = "low"           # ~5 strikes to ban
+    MID_TOLERANCE_ERROR = "mid"           # ~10 strikes to disconnect
+    HIGH_TOLERANCE_ERROR = "high"         # many strikes
+    VALUABLE_MESSAGE = "valuable"         # positive reinforcement
+
+    def score_delta(self) -> float:
+        return {
+            PeerAction.FATAL: MIN_SCORE_BEFORE_BAN * 2,
+            PeerAction.LOW_TOLERANCE_ERROR: -10.0,
+            PeerAction.MID_TOLERANCE_ERROR: -5.0,
+            PeerAction.HIGH_TOLERANCE_ERROR: -1.0,
+            PeerAction.VALUABLE_MESSAGE: 0.2,
+        }[self]
+
+
+class PeerStatus(Enum):
+    CONNECTED = "connected"
+    DISCONNECTED = "disconnected"
+    BANNED = "banned"
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    score: float = 0.0
+    status: PeerStatus = PeerStatus.CONNECTED
+    last_update: float = 0.0
+    enr: dict = field(default_factory=dict)  # subnet advertisement etc.
+    head_slot: int = 0
+    finalized_epoch: int = 0
+
+
+class PeerManager:
+    """Score bookkeeping + ban decisions. The transport consults
+    ``is_banned`` before delivering, and the router reports misbehavior
+    via ``report_peer``."""
+
+    def __init__(self, clock=None, target_peers: int = 50):
+        import time as _time
+
+        self._now = clock if clock is not None else _time.monotonic
+        self.peers: dict[str, PeerInfo] = {}
+        self.target_peers = target_peers
+
+    # ------------------------------------------------------------- lifecycle
+    def connect(self, peer_id: str) -> PeerInfo:
+        info = self.peers.get(peer_id)
+        if info is None:
+            info = PeerInfo(peer_id, last_update=self._now())
+            self.peers[peer_id] = info
+        if info.status != PeerStatus.BANNED:
+            info.status = PeerStatus.CONNECTED
+        return info
+
+    def disconnect(self, peer_id: str) -> None:
+        info = self.peers.get(peer_id)
+        if info is not None and info.status == PeerStatus.CONNECTED:
+            info.status = PeerStatus.DISCONNECTED
+
+    # --------------------------------------------------------------- scoring
+    def _decay(self, info: PeerInfo) -> None:
+        now = self._now()
+        dt = max(0.0, now - info.last_update)
+        if dt > 0:
+            info.score *= 0.5 ** (dt / HALFLIFE_SECS)
+            info.last_update = now
+
+    def report_peer(self, peer_id: str, action: PeerAction) -> PeerStatus:
+        info = self.connect(peer_id)
+        self._decay(info)
+        info.score += action.score_delta()
+        if info.score <= MIN_SCORE_BEFORE_BAN:
+            info.status = PeerStatus.BANNED
+        elif info.score <= MIN_SCORE_BEFORE_DISCONNECT:
+            info.status = PeerStatus.DISCONNECTED
+        return info.status
+
+    def score(self, peer_id: str) -> float:
+        info = self.peers.get(peer_id)
+        if info is None:
+            return 0.0
+        self._decay(info)
+        return info.score
+
+    def is_banned(self, peer_id: str) -> bool:
+        info = self.peers.get(peer_id)
+        return info is not None and info.status == PeerStatus.BANNED
+
+    def is_connected(self, peer_id: str) -> bool:
+        info = self.peers.get(peer_id)
+        return info is not None and info.status == PeerStatus.CONNECTED
+
+    def connected_peers(self) -> list[str]:
+        return [
+            p for p, i in self.peers.items() if i.status == PeerStatus.CONNECTED
+        ]
+
+    # ---------------------------------------------------------------- status
+    def update_chain_status(self, peer_id: str, head_slot: int, finalized_epoch: int):
+        info = self.connect(peer_id)
+        info.head_slot = max(info.head_slot, head_slot)
+        info.finalized_epoch = max(info.finalized_epoch, finalized_epoch)
+
+    def best_peer(self) -> str | None:
+        """Highest head slot among connected peers (sync target pick)."""
+        best = None
+        for p, i in self.peers.items():
+            if i.status != PeerStatus.CONNECTED:
+                continue
+            if best is None or i.head_slot > self.peers[best].head_slot:
+                best = p
+        return best

@@ -1,0 +1,195 @@
+"""Router — gossip/RPC classification into BeaconProcessor work
+(reference: network/src/router/mod.rs, handle_gossip:202, plus the
+worker bodies in beacon_processor/worker/gossip_methods.rs).
+
+The router owns the handler side of the processor queues: batched
+attestation/aggregate verification through the chain's batch pipeline
+(the TPU hot path), block import with unknown-parent hand-off to the
+SyncManager, and op-pool ingestion for exits/slashings. Verified gossip
+is re-published (gossipsub propagation) and misbehavior is reported to
+the PeerManager.
+"""
+
+from __future__ import annotations
+
+from ..chain.beacon_chain import AttestationError, BlockError
+from ..consensus.verify_operation import OperationError
+from . import gossip as g
+from .peer_manager import PeerAction
+from .processor import BeaconProcessor, WorkEvent, WorkType
+
+_KIND_TO_WORK = {
+    g.BEACON_BLOCK: WorkType.GOSSIP_BLOCK,
+    g.BEACON_AGGREGATE_AND_PROOF: WorkType.GOSSIP_AGGREGATE,
+    g.VOLUNTARY_EXIT: WorkType.GOSSIP_VOLUNTARY_EXIT,
+    g.PROPOSER_SLASHING: WorkType.GOSSIP_PROPOSER_SLASHING,
+    g.ATTESTER_SLASHING: WorkType.GOSSIP_ATTESTER_SLASHING,
+    g.SYNC_CONTRIBUTION_AND_PROOF: WorkType.GOSSIP_SYNC_CONTRIBUTION,
+}
+
+
+class Router:
+    def __init__(self, chain, processor: BeaconProcessor, peer_manager,
+                 publish=None, sync_manager=None):
+        self.chain = chain
+        self.processor = processor
+        self.peer_manager = peer_manager
+        self.publish = publish  # fn(kind, item) -> None (service re-publish)
+        self.sync = sync_manager
+        self.stats = {
+            "attestations_verified": 0,
+            "attestations_rejected": 0,
+            "aggregates_verified": 0,
+            "blocks_imported": 0,
+            "blocks_rejected": 0,
+            "ops_accepted": 0,
+        }
+        p = processor
+        p.register(WorkType.GOSSIP_ATTESTATION, self._work_attestation_batch)
+        p.register(WorkType.GOSSIP_AGGREGATE, self._work_aggregate_batch)
+        p.register(WorkType.GOSSIP_BLOCK, self._work_gossip_block)
+        p.register(WorkType.RPC_BLOCK, self._work_rpc_block)
+        p.register(WorkType.CHAIN_SEGMENT, self._work_chain_segment)
+        p.register(WorkType.GOSSIP_VOLUNTARY_EXIT, self._work_voluntary_exit)
+        p.register(WorkType.GOSSIP_PROPOSER_SLASHING, self._work_proposer_slashing)
+        p.register(WorkType.GOSSIP_ATTESTER_SLASHING, self._work_attester_slashing)
+
+    # -------------------------------------------------------------- ingress
+    def handle_gossip(self, topic: g.GossipTopic, message: g.PubsubMessage,
+                      source_peer: str, msg_id: bytes) -> None:
+        """Classify a decoded pubsub message into a work event
+        (router/mod.rs:202 handle_gossip)."""
+        kind = message.kind
+        if kind.startswith(g.BEACON_ATTESTATION_PREFIX):
+            wt = WorkType.GOSSIP_ATTESTATION
+        elif kind.startswith(g.SYNC_COMMITTEE_PREFIX) and kind != g.SYNC_CONTRIBUTION_AND_PROOF:
+            wt = WorkType.GOSSIP_SYNC_SIGNATURE
+        else:
+            wt = _KIND_TO_WORK.get(kind)
+            if wt is None:
+                self.peer_manager.report_peer(source_peer, PeerAction.LOW_TOLERANCE_ERROR)
+                return
+        self.processor.send(
+            WorkEvent(
+                wt,
+                message.item,
+                peer_id=source_peer,
+                message_id=msg_id,
+                topic_kind=kind,
+            )
+        )
+
+    # -------------------------------------------------------------- workers
+    def _work_attestation_batch(self, events: list[WorkEvent]) -> None:
+        """gossip_methods.rs:257 process_gossip_attestation_batch."""
+        results = self.chain.batch_verify_unaggregated_attestations_for_gossip(
+            [e.payload for e in events]
+        )
+        for ev, res in zip(events, results):
+            if isinstance(res, Exception):
+                self.stats["attestations_rejected"] += 1
+                if ev.peer_id is not None:
+                    self.peer_manager.report_peer(
+                        ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
+                    )
+                continue
+            self.stats["attestations_verified"] += 1
+            self.chain.apply_attestation_to_fork_choice(res)
+            self.chain.add_to_naive_aggregation_pool(res)
+            if self.publish is not None:
+                kind = ev.topic_kind or f"{g.BEACON_ATTESTATION_PREFIX}0"
+                self.publish(kind, ev.payload, forward=True)
+
+    def _work_aggregate_batch(self, events: list[WorkEvent]) -> None:
+        for ev in events:
+            try:
+                verified = self.chain.verify_aggregated_attestation_for_gossip(
+                    ev.payload
+                )
+            except (AttestationError, ValueError):
+                self.stats["attestations_rejected"] += 1
+                if ev.peer_id is not None:
+                    self.peer_manager.report_peer(
+                        ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
+                    )
+                continue
+            self.stats["aggregates_verified"] += 1
+            self.chain.apply_attestation_to_fork_choice(verified)
+            self.chain.add_to_operation_pool(verified)
+            if self.publish is not None:
+                self.publish(g.BEACON_AGGREGATE_AND_PROOF, ev.payload, forward=True)
+
+    def _import_block(self, ev: WorkEvent, *, republish: bool) -> None:
+        try:
+            self.chain.process_block(ev.payload)
+        except BlockError as e:
+            if "unknown parent" in str(e) and self.sync is not None:
+                self.sync.on_unknown_parent(ev.payload, ev.peer_id)
+                return
+            self.stats["blocks_rejected"] += 1
+            if ev.peer_id is not None:
+                self.peer_manager.report_peer(ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR)
+            return
+        self.stats["blocks_imported"] += 1
+        if ev.peer_id is not None:
+            self.peer_manager.report_peer(ev.peer_id, PeerAction.VALUABLE_MESSAGE)
+        if republish and self.publish is not None:
+            self.publish(g.BEACON_BLOCK, ev.payload, forward=True)
+        if self.sync is not None:
+            self.sync.on_block_imported(ev.payload)
+
+    def _work_gossip_block(self, ev: WorkEvent) -> None:
+        self._import_block(ev, republish=True)
+
+    def _work_rpc_block(self, ev: WorkEvent) -> None:
+        self._import_block(ev, republish=False)
+
+    def _work_chain_segment(self, ev: WorkEvent) -> None:
+        for block in ev.payload:
+            self._import_block(
+                WorkEvent(WorkType.RPC_BLOCK, block, peer_id=ev.peer_id),
+                republish=False,
+            )
+
+    # ---------------------------------------------------- pool-bound gossip
+    def _pool_op(self, ev: WorkEvent, insert, kind: str) -> None:
+        try:
+            insert(self.chain.head().state, ev.payload)
+        except (OperationError, ValueError):
+            if ev.peer_id is not None:
+                self.peer_manager.report_peer(ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR)
+            return
+        self.stats["ops_accepted"] += 1
+        if self.publish is not None:
+            self.publish(kind, ev.payload, forward=True)
+
+    def _work_voluntary_exit(self, ev: WorkEvent) -> None:
+        from ..consensus.verify_operation import verify_exit
+
+        def insert(state, op):
+            verified = verify_exit(state, op, self.chain.spec, backend=self.chain.backend)
+            self.chain.op_pool.insert_voluntary_exit(verified)
+
+        self._pool_op(ev, insert, g.VOLUNTARY_EXIT)
+
+    def _work_proposer_slashing(self, ev: WorkEvent) -> None:
+        from ..consensus.verify_operation import verify_proposer_slashing
+
+        def insert(state, op):
+            verified = verify_proposer_slashing(
+                state, op, self.chain.spec, backend=self.chain.backend
+            )
+            self.chain.op_pool.insert_proposer_slashing(verified)
+
+        self._pool_op(ev, insert, g.PROPOSER_SLASHING)
+
+    def _work_attester_slashing(self, ev: WorkEvent) -> None:
+        from ..consensus.verify_operation import verify_attester_slashing
+
+        def insert(state, op):
+            verified = verify_attester_slashing(
+                state, op, self.chain.spec, backend=self.chain.backend
+            )
+            self.chain.op_pool.insert_attester_slashing(verified)
+
+        self._pool_op(ev, insert, g.ATTESTER_SLASHING)

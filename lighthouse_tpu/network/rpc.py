@@ -1,0 +1,183 @@
+"""Req/resp RPC protocols (reference: lighthouse_network/src/rpc/).
+
+Protocols: Status, Goodbye, Ping, Metadata, BeaconBlocksByRange,
+BeaconBlocksByRoot — each a protocol id string
+(`/eth2/beacon_chain/req/{name}/{version}/ssz_snappy`), an SSZ request
+container, and zero-or-more SSZ response chunks
+(`rpc/protocol.rs:31-…`, `rpc/codec/`). Response chunks carry a result
+byte (0 success / 1 InvalidRequest / 2 ServerError / 3 ResourceUnavail)
+followed by the ssz_snappy payload, and requests are rate-limited per
+peer per protocol with token buckets (`rpc/rate_limiter.rs`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import IntEnum
+
+from ..consensus.ssz import Bytes4, Bytes32, Container, List, uint64
+from . import snappy
+
+PROTOCOL_PREFIX = "/eth2/beacon_chain/req"
+
+
+def protocol_id(name: str, version: int = 1) -> str:
+    return f"{PROTOCOL_PREFIX}/{name}/{version}/ssz_snappy"
+
+
+STATUS = protocol_id("status")
+GOODBYE = protocol_id("goodbye")
+PING = protocol_id("ping")
+METADATA = protocol_id("metadata", 2)
+BLOCKS_BY_RANGE = protocol_id("beacon_blocks_by_range", 2)
+BLOCKS_BY_ROOT = protocol_id("beacon_blocks_by_root", 2)
+
+MAX_REQUEST_BLOCKS = 1024
+
+
+class RpcErrorCode(IntEnum):
+    SUCCESS = 0
+    INVALID_REQUEST = 1
+    SERVER_ERROR = 2
+    RESOURCE_UNAVAILABLE = 3
+    RATE_LIMITED = 139  # local-only marker
+
+
+class RpcError(Exception):
+    def __init__(self, code: RpcErrorCode, message: str = ""):
+        super().__init__(f"rpc error {code.name}: {message}")
+        self.code = code
+        self.message = message
+
+
+class GoodbyeReason(IntEnum):
+    CLIENT_SHUTDOWN = 1
+    IRRELEVANT_NETWORK = 2
+    FAULT_OR_ERROR = 3
+    TOO_MANY_PEERS = 129
+    BAD_SCORE = 250
+    BANNED = 251
+
+
+class StatusMessage(Container):
+    """Chain-head handshake (rpc/methods.rs StatusMessage)."""
+
+    fields = {
+        "fork_digest": Bytes4,
+        "finalized_root": Bytes32,
+        "finalized_epoch": uint64,
+        "head_root": Bytes32,
+        "head_slot": uint64,
+    }
+
+
+class PingData(Container):
+    fields = {"data": uint64}
+
+
+class MetadataResponse(Container):
+    """seq_number + attnets/syncnets bitfields, packed as uint64s for
+    the in-process wire (the reference uses SSZ bitvectors)."""
+
+    fields = {"seq_number": uint64, "attnets": uint64, "syncnets": uint64}
+
+
+class BlocksByRangeRequest(Container):
+    fields = {"start_slot": uint64, "count": uint64, "step": uint64}
+
+
+class BlocksByRootRequest(Container):
+    fields = {"block_roots": List(Bytes32, MAX_REQUEST_BLOCKS)}
+
+
+class GoodbyeMessage(Container):
+    fields = {"reason": uint64}
+
+
+REQUEST_TYPE = {
+    STATUS: StatusMessage,
+    GOODBYE: GoodbyeMessage,
+    PING: PingData,
+    METADATA: None,  # metadata request has an empty body
+    BLOCKS_BY_RANGE: BlocksByRangeRequest,
+    BLOCKS_BY_ROOT: BlocksByRootRequest,
+}
+
+
+# --------------------------------------------------------------- wire codec
+def encode_request(protocol: str, request) -> bytes:
+    if REQUEST_TYPE[protocol] is None:
+        return b""
+    return snappy.compress(request.encode())
+
+
+def decode_request(protocol: str, wire: bytes):
+    cls = REQUEST_TYPE[protocol]
+    if cls is None:
+        return None
+    return cls.decode(snappy.decompress(wire))
+
+
+def encode_response_chunk(payload_ssz: bytes, code: RpcErrorCode = RpcErrorCode.SUCCESS) -> bytes:
+    return bytes([code]) + snappy.compress(payload_ssz)
+
+
+def decode_response_chunk(wire: bytes) -> tuple[RpcErrorCode, bytes]:
+    if not wire:
+        raise RpcError(RpcErrorCode.SERVER_ERROR, "empty response chunk")
+    code = RpcErrorCode(wire[0])
+    payload = snappy.decompress(wire[1:]) if len(wire) > 1 else b""
+    if code != RpcErrorCode.SUCCESS:
+        raise RpcError(code, payload.decode("utf-8", "replace"))
+    return code, payload
+
+
+# -------------------------------------------------------------- rate limits
+@dataclass
+class _Bucket:
+    capacity: float
+    refill_per_sec: float
+    tokens: float
+    last: float
+
+
+class RateLimiter:
+    """Token-bucket per (peer, protocol) (rpc/rate_limiter.rs). Quotas
+    follow the reference's defaults: generous for small control
+    messages, tight for block ranges."""
+
+    DEFAULT_QUOTAS = {
+        STATUS: (5, 15.0),           # 5 tokens / 15s window
+        GOODBYE: (1, 10.0),
+        PING: (2, 10.0),
+        METADATA: (2, 5.0),
+        BLOCKS_BY_RANGE: (1024, 10.0),  # tokens are *blocks requested*
+        BLOCKS_BY_ROOT: (128, 10.0),
+    }
+
+    def __init__(self, clock=None):
+        import time as _time
+
+        self._now = clock if clock is not None else _time.monotonic
+        self._buckets: dict[tuple[str, str], _Bucket] = {}
+
+    def allows(self, peer_id: str, protocol: str, tokens: float = 1.0) -> bool:
+        cap, window = self.DEFAULT_QUOTAS.get(protocol, (10, 10.0))
+        key = (peer_id, protocol)
+        now = self._now()
+        b = self._buckets.get(key)
+        if b is None:
+            b = _Bucket(cap, cap / window, float(cap), now)
+            self._buckets[key] = b
+        b.tokens = min(b.capacity, b.tokens + (now - b.last) * b.refill_per_sec)
+        b.last = now
+        if tokens > b.capacity:
+            return False  # request can never fit the quota
+        if b.tokens >= tokens:
+            b.tokens -= tokens
+            return True
+        return False
+
+    def prune_peer(self, peer_id: str) -> None:
+        for key in [k for k in self._buckets if k[0] == peer_id]:
+            del self._buckets[key]

@@ -1,0 +1,237 @@
+"""NetworkService — wires chain ↔ transport ↔ processor ↔ sync.
+
+Capability mirror of `network/src/service.rs:119`: owns the transport
+peer (the swarm), subscribes to core topics + attestation subnets,
+decodes inbound gossip into the Router, answers req/resp RPC (Status,
+Ping, Metadata, BlocksByRange/Root from the store with rate limiting),
+and publishes locally-produced messages. ``poll()`` drives one
+deterministic delivery + processing round (the event loop turn).
+"""
+
+from __future__ import annotations
+
+from . import gossip as g
+from . import rpc
+from .peer_manager import PeerAction, PeerManager
+from .processor import BeaconProcessor
+from .router import Router
+from .sync import SyncManager
+from .transport import InMemoryHub, Peer
+
+
+class NetworkService:
+    def __init__(
+        self,
+        chain,
+        hub: InMemoryHub,
+        node_id: str,
+        attestation_batch_size: int = 1024,
+        subscribe_all_subnets: bool = True,
+    ):
+        self.chain = chain
+        self.node_id = node_id
+        self.peer: Peer = hub.join(node_id)
+        self.peer_manager = PeerManager()
+        self.processor = BeaconProcessor(attestation_batch_size)
+        self.sync = SyncManager(
+            chain, self.peer, self.peer_manager, self.processor, chain.spec
+        )
+        self.router = Router(
+            chain,
+            self.processor,
+            self.peer_manager,
+            publish=self._publish_kind,
+            sync_manager=self.sync,
+        )
+        self.rate_limiter = rpc.RateLimiter()
+        self.metadata_seq = 0
+
+        self.fork_digest = chain.spec.compute_fork_digest(
+            chain.spec.fork_version_at_epoch(
+                int(chain.head().state.slot) // chain.spec.preset.SLOTS_PER_EPOCH
+            ),
+            chain.genesis_validators_root,
+        )
+        self._subscribe_topics(subscribe_all_subnets)
+        self._register_rpc()
+        self.peer.on_gossip = self._on_gossip
+
+    # --------------------------------------------------------------- topics
+    def _subscribe_topics(self, all_subnets: bool) -> None:
+        for kind in g.CORE_TOPICS:
+            self.peer.subscribe(str(g.GossipTopic(self.fork_digest, kind)))
+        subnets = range(g.ATTESTATION_SUBNET_COUNT) if all_subnets else ()
+        for subnet in subnets:
+            self.peer.subscribe(
+                str(g.GossipTopic.attestation_subnet(self.fork_digest, subnet))
+            )
+        for subnet in range(g.SYNC_COMMITTEE_SUBNET_COUNT):
+            self.peer.subscribe(
+                str(g.GossipTopic.sync_subnet(self.fork_digest, subnet))
+            )
+
+    # --------------------------------------------------------------- gossip
+    def _on_gossip(self, topic: str, msg_id: bytes, wire: bytes, source: str):
+        if self.peer_manager.is_banned(source):
+            return
+        try:
+            parsed = g.GossipTopic.parse(topic)
+            fork = self.chain.spec.fork_name_at_epoch(
+                self.chain.current_slot() // self.chain.spec.preset.SLOTS_PER_EPOCH
+            )
+            message = g.PubsubMessage.decode(parsed, wire, self.chain.types, fork)
+        except (ValueError, KeyError):
+            self.peer_manager.report_peer(source, PeerAction.LOW_TOLERANCE_ERROR)
+            return
+        self.peer_manager.connect(source)
+        self.router.handle_gossip(parsed, message, source, msg_id)
+
+    def _publish_kind(self, kind: str, item, forward: bool = False) -> None:
+        topic = g.GossipTopic(self.fork_digest, kind)
+        wire = g.PubsubMessage(kind, item).encode()
+        self.peer.publish(str(topic), wire)
+
+    # public publish API (used by validator client / http api)
+    def publish_block(self, signed_block) -> None:
+        self._publish_kind(g.BEACON_BLOCK, signed_block)
+
+    def publish_attestation(self, attestation, subnet_id: int = 0) -> None:
+        self._publish_kind(
+            f"{g.BEACON_ATTESTATION_PREFIX}{subnet_id}", attestation
+        )
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self._publish_kind(g.BEACON_AGGREGATE_AND_PROOF, signed_aggregate)
+
+    def publish_voluntary_exit(self, signed_exit) -> None:
+        self._publish_kind(g.VOLUNTARY_EXIT, signed_exit)
+
+    def publish_proposer_slashing(self, slashing) -> None:
+        self._publish_kind(g.PROPOSER_SLASHING, slashing)
+
+    def publish_attester_slashing(self, slashing) -> None:
+        self._publish_kind(g.ATTESTER_SLASHING, slashing)
+
+    # ------------------------------------------------------------------ rpc
+    def _register_rpc(self) -> None:
+        self.peer.register_rpc(rpc.STATUS, self._serve_status)
+        self.peer.register_rpc(rpc.PING, self._serve_ping)
+        self.peer.register_rpc(rpc.METADATA, self._serve_metadata)
+        self.peer.register_rpc(rpc.BLOCKS_BY_RANGE, self._serve_blocks_by_range)
+        self.peer.register_rpc(rpc.BLOCKS_BY_ROOT, self._serve_blocks_by_root)
+        self.peer.register_rpc(rpc.GOODBYE, self._serve_goodbye)
+
+    def _rate_check(self, peer_id: str, protocol: str, tokens: float = 1.0):
+        if not self.rate_limiter.allows(peer_id, protocol, tokens):
+            raise rpc.RpcError(rpc.RpcErrorCode.RATE_LIMITED, "rate limited")
+
+    def local_status(self) -> rpc.StatusMessage:
+        head = self.chain.head()
+        fin_epoch, fin_root = self.chain.finalized_checkpoint()
+        return rpc.StatusMessage(
+            fork_digest=self.fork_digest,
+            finalized_root=fin_root,
+            finalized_epoch=fin_epoch,
+            head_root=head.root,
+            head_slot=int(head.block.message.slot),
+        )
+
+    def _serve_status(self, peer_id: str, wire: bytes):
+        self._rate_check(peer_id, rpc.STATUS)
+        remote = rpc.decode_request(rpc.STATUS, wire)
+        if bytes(remote.fork_digest) != self.fork_digest:
+            return [
+                rpc.encode_response_chunk(
+                    b"irrelevant network", rpc.RpcErrorCode.INVALID_REQUEST
+                )
+            ]
+        self.peer_manager.connect(peer_id)
+        self.sync.on_peer_status(peer_id, remote)
+        return [rpc.encode_response_chunk(self.local_status().encode())]
+
+    def _serve_ping(self, peer_id: str, wire: bytes):
+        self._rate_check(peer_id, rpc.PING)
+        rpc.decode_request(rpc.PING, wire)
+        return [
+            rpc.encode_response_chunk(
+                rpc.PingData(data=self.metadata_seq).encode()
+            )
+        ]
+
+    def _serve_metadata(self, peer_id: str, wire: bytes):
+        self._rate_check(peer_id, rpc.METADATA)
+        attnets = (1 << g.ATTESTATION_SUBNET_COUNT) - 1 & 0xFFFFFFFFFFFFFFFF
+        return [
+            rpc.encode_response_chunk(
+                rpc.MetadataResponse(
+                    seq_number=self.metadata_seq, attnets=attnets, syncnets=0xF
+                ).encode()
+            )
+        ]
+
+    def _serve_goodbye(self, peer_id: str, wire: bytes):
+        self.peer_manager.disconnect(peer_id)
+        self.rate_limiter.prune_peer(peer_id)
+        return []
+
+    def _serve_blocks_by_range(self, peer_id: str, wire: bytes):
+        req = rpc.decode_request(rpc.BLOCKS_BY_RANGE, wire)
+        count = min(int(req.count), rpc.MAX_REQUEST_BLOCKS)
+        self._rate_check(peer_id, rpc.BLOCKS_BY_RANGE, tokens=float(count))
+        start = int(req.start_slot)
+        head = self.chain.head()
+        chunks = []
+        try:
+            for _slot, root in self.chain.store.forwards_block_roots_iterator(
+                start, start + count - 1, head.state
+            ):
+                block = self.chain.store.get_block(root)
+                if block is not None:
+                    chunks.append(rpc.encode_response_chunk(block.encode()))
+        except Exception:
+            pass  # slots beyond our window: return what we have
+        # the head block itself (forwards iterator covers roots *behind*
+        # the head state)
+        if start <= int(head.block.message.slot) <= start + count - 1:
+            chunks.append(rpc.encode_response_chunk(head.block.encode()))
+        return chunks
+
+    def _serve_blocks_by_root(self, peer_id: str, wire: bytes):
+        req = rpc.decode_request(rpc.BLOCKS_BY_ROOT, wire)
+        self._rate_check(
+            peer_id, rpc.BLOCKS_BY_ROOT, tokens=float(len(req.block_roots))
+        )
+        chunks = []
+        for root in req.block_roots:
+            block = self.chain.store.get_block(bytes(root))
+            if block is not None:
+                chunks.append(rpc.encode_response_chunk(block.encode()))
+        return chunks
+
+    # ------------------------------------------------------------- liveness
+    def send_status(self, peer_id: str) -> rpc.StatusMessage | None:
+        """Handshake with a peer (the dial-out path)."""
+        try:
+            chunks = self.peer.request(
+                peer_id,
+                rpc.STATUS,
+                rpc.encode_request(rpc.STATUS, self.local_status()),
+            )
+        except (ConnectionError, rpc.RpcError):
+            return None
+        if not chunks:
+            return None
+        try:
+            _, payload = rpc.decode_response_chunk(chunks[0])
+        except rpc.RpcError:
+            return None
+        remote = rpc.StatusMessage.decode(payload)
+        self.peer_manager.connect(peer_id)
+        self.sync.on_peer_status(peer_id, remote)
+        return remote
+
+    def poll(self) -> int:
+        """One event-loop turn: deliver queued gossip, then drain the
+        processor. Returns events processed."""
+        self.peer.deliver_pending()
+        return self.processor.process_pending()

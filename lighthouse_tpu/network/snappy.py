@@ -1,0 +1,190 @@
+"""Pure-Python snappy *block format* codec.
+
+The reference compresses every gossip payload and RPC chunk with snappy
+(the C `snap` crate; `lighthouse_network/src/types/pubsub.rs:38-42`,
+`rpc/codec/`). This image has no snappy binding, so the codec is
+implemented here from the format spec: a little-endian varint preamble
+carrying the uncompressed length, then a stream of literal / copy
+elements. The compressor is a greedy single-pass matcher over a 4-byte
+hash table (the same structure snappy's reference C implementation
+uses, minus the fine tuning); the decompressor handles the full format
+including overlapping copies.
+
+Used by ``gossip`` and ``rpc`` as the ``ssz_snappy`` encoding layer.
+"""
+
+from __future__ import annotations
+
+MAX_UNCOMPRESSED = 1 << 24  # sanity bound for this node's payloads (16 MiB)
+
+_TAG_LITERAL = 0
+_TAG_COPY1 = 1
+_TAG_COPY2 = 2
+_TAG_COPY4 = 3
+
+
+def _write_varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("snappy: truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 35:
+            raise ValueError("snappy: varint too long")
+
+
+def _emit_literal(out: bytearray, data: bytes, start: int, end: int) -> None:
+    length = end - start
+    if length == 0:
+        return
+    n = length - 1
+    if n < 60:
+        out.append(n << 2)
+    elif n < (1 << 8):
+        out.append(60 << 2)
+        out.append(n)
+    elif n < (1 << 16):
+        out.append(61 << 2)
+        out += n.to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append(62 << 2)
+        out += n.to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += n.to_bytes(4, "little")
+    out += data[start:end]
+
+
+def _emit_copy(out: bytearray, offset: int, length: int) -> None:
+    # split long matches into <=64-byte copies
+    while length >= 68:
+        _emit_copy_chunk(out, offset, 64)
+        length -= 64
+    if length > 64:
+        _emit_copy_chunk(out, offset, length - 60)
+        length = 60
+    _emit_copy_chunk(out, offset, length)
+
+
+def _emit_copy_chunk(out: bytearray, offset: int, length: int) -> None:
+    if length >= 4 and length < 12 and offset < 2048:
+        out.append(
+            _TAG_COPY1 | ((length - 4) << 2) | ((offset >> 8) << 5)
+        )
+        out.append(offset & 0xFF)
+    elif offset < (1 << 16):
+        out.append(_TAG_COPY2 | ((length - 1) << 2))
+        out += offset.to_bytes(2, "little")
+    else:
+        out.append(_TAG_COPY4 | ((length - 1) << 2))
+        out += offset.to_bytes(4, "little")
+
+
+def compress(data: bytes) -> bytes:
+    """Greedy hash-match compressor producing valid snappy block output."""
+    data = bytes(data)
+    n = len(data)
+    out = bytearray(_write_varint(n))
+    if n == 0:
+        return bytes(out)
+    if n < 16:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+
+    table: dict[bytes, int] = {}
+    pos = 0
+    literal_start = 0
+    limit = n - 4
+    while pos <= limit:
+        key = data[pos : pos + 4]
+        cand = table.get(key)
+        table[key] = pos
+        if cand is not None and data[cand : cand + 4] == key:
+            # extend the match forward
+            match_len = 4
+            while (
+                pos + match_len < n
+                and data[cand + match_len] == data[pos + match_len]
+            ):
+                match_len += 1
+            _emit_literal(out, data, literal_start, pos)
+            _emit_copy(out, pos - cand, match_len)
+            pos += match_len
+            literal_start = pos
+        else:
+            pos += 1
+    _emit_literal(out, data, literal_start, n)
+    return bytes(out)
+
+
+def decompress(buf: bytes) -> bytes:
+    buf = bytes(buf)
+    expected, pos = _read_varint(buf, 0)
+    if expected > MAX_UNCOMPRESSED:
+        raise ValueError("snappy: declared length too large")
+    out = bytearray()
+    n = len(buf)
+    while pos < n:
+        tag = buf[pos]
+        pos += 1
+        kind = tag & 0x03
+        if kind == _TAG_LITERAL:
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > n:
+                    raise ValueError("snappy: truncated literal length")
+                length = int.from_bytes(buf[pos : pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("snappy: truncated literal")
+            out += buf[pos : pos + length]
+            pos += length
+            continue
+        if kind == _TAG_COPY1:
+            length = ((tag >> 2) & 0x07) + 4
+            if pos >= n:
+                raise ValueError("snappy: truncated copy-1")
+            offset = ((tag >> 5) << 8) | buf[pos]
+            pos += 1
+        elif kind == _TAG_COPY2:
+            length = (tag >> 2) + 1
+            if pos + 2 > n:
+                raise ValueError("snappy: truncated copy-2")
+            offset = int.from_bytes(buf[pos : pos + 2], "little")
+            pos += 2
+        else:
+            length = (tag >> 2) + 1
+            if pos + 4 > n:
+                raise ValueError("snappy: truncated copy-4")
+            offset = int.from_bytes(buf[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("snappy: invalid copy offset")
+        start = len(out) - offset
+        if offset >= length:
+            out += out[start : start + length]
+        else:
+            # overlapping copy (RLE) must be byte-sequential
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != expected:
+        raise ValueError(
+            f"snappy: length mismatch (got {len(out)}, expected {expected})"
+        )
+    return bytes(out)

@@ -1,0 +1,220 @@
+"""SyncManager — range sync, backfill, and parent lookups.
+
+Capability mirror of `network/src/sync/manager.rs:155` with its three
+strategies:
+
+* **RangeSync** (`sync/range_sync/`) — when a peer's Status advertises
+  a higher finalized/head slot, pull BeaconBlocksByRange in batches of
+  ``EPOCHS_PER_BATCH`` epochs and feed them to the processor as
+  CHAIN_SEGMENT work, advancing batch-by-batch until caught up.
+* **Parent lookups** (`sync/block_lookups/`) — a gossip block with an
+  unknown parent triggers recursive BlocksByRoot requests up the
+  ancestry (bounded by ``PARENT_DEPTH_TOLERANCE``) and then imports
+  the collected segment child-last.
+* **BackFillSync** (`sync/backfill_sync/`) — after checkpoint sync,
+  download history *backwards* from the anchor to genesis; blocks are
+  validated by parent-hash linkage and stored, not replayed.
+
+State transitions are synchronous and deterministic: callers drive
+``tick()``; network requests happen inline over the transport.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+from . import rpc
+from .peer_manager import PeerAction
+from .processor import WorkEvent, WorkType
+
+EPOCHS_PER_BATCH = 2
+PARENT_DEPTH_TOLERANCE = 16
+
+
+class SyncState(Enum):
+    STALLED = "stalled"
+    SYNCING_FINALIZED = "syncing_finalized"
+    SYNCING_HEAD = "syncing_head"
+    SYNCED = "synced"
+    BACKFILLING = "backfilling"
+
+
+class SyncManager:
+    def __init__(self, chain, peer, peer_manager, processor, spec):
+        self.chain = chain
+        self.peer = peer  # transport Peer handle
+        self.peer_manager = peer_manager
+        self.processor = processor
+        self.spec = spec
+        self.state = SyncState.SYNCED
+        self.parent_lookups: dict[bytes, int] = {}  # tip root -> depth
+        self.backfill_anchor_slot: int | None = None
+        self.stats = {"range_batches": 0, "parent_lookups": 0, "backfill_batches": 0}
+
+    # ------------------------------------------------------------ peer status
+    def on_peer_status(self, peer_id: str, status: rpc.StatusMessage) -> None:
+        """Decide whether the peer knows a longer chain (manager.rs
+        add_peer → RangeSync)."""
+        self.peer_manager.update_chain_status(
+            peer_id, int(status.head_slot), int(status.finalized_epoch)
+        )
+        head_slot = int(self.chain.head().block.message.slot)
+        if int(status.head_slot) > head_slot:
+            self.state = SyncState.SYNCING_HEAD
+            self.range_sync(peer_id, int(status.head_slot))
+
+    # -------------------------------------------------------------- range sync
+    def range_sync(self, peer_id: str, target_slot: int) -> None:
+        """Pull [head+1, target] in EPOCHS_PER_BATCH batches and enqueue
+        as chain segments."""
+        p = self.spec.preset
+        batch_span = EPOCHS_PER_BATCH * p.SLOTS_PER_EPOCH
+        start = int(self.chain.head().block.message.slot) + 1
+        while start <= target_slot:
+            count = min(batch_span, target_slot - start + 1)
+            blocks = self._request_range(peer_id, start, count)
+            if blocks is None:
+                self.state = SyncState.STALLED
+                return
+            if blocks:
+                self.processor.send(
+                    WorkEvent(WorkType.CHAIN_SEGMENT, blocks, peer_id=peer_id)
+                )
+                self.processor.process_pending()
+                self.stats["range_batches"] += 1
+            start += count
+        head_slot = int(self.chain.head().block.message.slot)
+        self.state = (
+            SyncState.SYNCED if head_slot >= target_slot - 1 else SyncState.STALLED
+        )
+
+    def _request_range(self, peer_id: str, start_slot: int, count: int):
+        req = rpc.BlocksByRangeRequest(start_slot=start_slot, count=count, step=1)
+        try:
+            chunks = self.peer.request(
+                peer_id, rpc.BLOCKS_BY_RANGE, rpc.encode_request(rpc.BLOCKS_BY_RANGE, req)
+            )
+        except (ConnectionError, rpc.RpcError):
+            return None
+        return self._decode_block_chunks(peer_id, chunks)
+
+    def _decode_block_chunks(self, peer_id: str, chunks):
+        blocks = []
+        types = self.chain.types
+        for chunk in chunks:
+            try:
+                _, payload = rpc.decode_response_chunk(chunk)
+            except rpc.RpcError:
+                return None
+            block = self._decode_block(types, payload)
+            if block is None:
+                self.peer_manager.report_peer(peer_id, PeerAction.LOW_TOLERANCE_ERROR)
+                return None
+            blocks.append(block)
+        return blocks
+
+    def _decode_block(self, types, payload: bytes):
+        # fork-agnostic decode: wire chunks don't carry the fork, so try
+        # each fork class and accept the one matching the fork schedule
+        # (the reference selects by the chunk's fork-context bytes)
+        for fork in reversed(list(types.SIGNED_BLOCK_BY_FORK)):
+            try:
+                block = types.SIGNED_BLOCK_BY_FORK[fork].decode(payload)
+            except (ValueError, IndexError):
+                continue
+            expected = self.spec.fork_name_at_epoch(
+                int(block.message.slot) // self.spec.preset.SLOTS_PER_EPOCH
+            )
+            if fork == expected:
+                return block
+        return None
+
+    # ---------------------------------------------------------- parent lookup
+    def on_unknown_parent(self, block, peer_id: str | None) -> None:
+        """Recursive BlocksByRoot walk up the missing ancestry
+        (block_lookups/parent_lookup.rs)."""
+        if peer_id is None:
+            peer_id = self.peer_manager.best_peer()
+            if peer_id is None:
+                return
+        self.stats["parent_lookups"] += 1
+        chain = [block]
+        seen = {bytes(block.message.parent_root)}
+        for _ in range(PARENT_DEPTH_TOLERANCE):
+            parent_root = bytes(chain[-1].message.parent_root)
+            if self.chain.fork_choice.contains_block(parent_root):
+                # ancestry connected: import oldest-first
+                segment = list(reversed(chain))
+                self.processor.send(
+                    WorkEvent(WorkType.CHAIN_SEGMENT, segment, peer_id=peer_id)
+                )
+                self.processor.process_pending()
+                return
+            parent = self._request_root(peer_id, parent_root)
+            if parent is None:
+                self.peer_manager.report_peer(peer_id, PeerAction.MID_TOLERANCE_ERROR)
+                return
+            if bytes(parent.message.parent_root) in seen:
+                self.peer_manager.report_peer(peer_id, PeerAction.FATAL)
+                return  # loop — malicious chain
+            seen.add(bytes(parent.message.parent_root))
+            chain.append(parent)
+        self.peer_manager.report_peer(peer_id, PeerAction.MID_TOLERANCE_ERROR)
+
+    def _request_root(self, peer_id: str, root: bytes):
+        req = rpc.BlocksByRootRequest(block_roots=[root])
+        try:
+            chunks = self.peer.request(
+                peer_id, rpc.BLOCKS_BY_ROOT, rpc.encode_request(rpc.BLOCKS_BY_ROOT, req)
+            )
+        except (ConnectionError, rpc.RpcError):
+            return None
+        blocks = self._decode_block_chunks(peer_id, chunks)
+        if not blocks:
+            return None
+        # the response must actually be the requested block
+        if blocks[0].message.hash_tree_root() != root:
+            self.peer_manager.report_peer(peer_id, PeerAction.LOW_TOLERANCE_ERROR)
+            return None
+        return blocks[0]
+
+    def on_block_imported(self, block) -> None:
+        """Hook for lookup bookkeeping (processed children may now import)."""
+
+    # ------------------------------------------------------------- backfill
+    def start_backfill(self, anchor_slot: int, peer_id: str | None = None) -> int:
+        """Download [genesis, anchor) backwards, verifying hash linkage
+        (backfill_sync/mod.rs). Blocks go straight to the store. Returns
+        number of blocks stored."""
+        if peer_id is None:
+            peer_id = self.peer_manager.best_peer()
+            if peer_id is None:
+                return 0
+        self.state = SyncState.BACKFILLING
+        p = self.spec.preset
+        batch_span = EPOCHS_PER_BATCH * p.SLOTS_PER_EPOCH
+        stored = 0
+        expected_root = None  # linkage: parent_root of the lowest stored block
+        anchor_block = self.chain.store.get_block(self.chain.head().root)
+        if anchor_block is not None:
+            expected_root = bytes(anchor_block.message.parent_root)
+        end = anchor_slot
+        while end > 0:
+            start = max(0, end - batch_span)
+            blocks = self._request_range(peer_id, start, end - start)
+            if blocks is None:
+                self.state = SyncState.STALLED
+                return stored
+            for block in reversed(blocks):
+                root = block.message.hash_tree_root()
+                if expected_root is not None and root != expected_root:
+                    self.peer_manager.report_peer(peer_id, PeerAction.FATAL)
+                    self.state = SyncState.STALLED
+                    return stored
+                self.chain.store.put_block(root, block)
+                expected_root = bytes(block.message.parent_root)
+                stored += 1
+            self.stats["backfill_batches"] += 1
+            end = start
+        self.state = SyncState.SYNCED
+        return stored

@@ -1,0 +1,149 @@
+"""The swarm seam: an in-process deterministic transport.
+
+The reference's `Service` owns a libp2p `Swarm` (TCP + noise + yamux,
+gossipsub mesh, discv5) — `lighthouse_network/src/service.rs:53-120`.
+This framework isolates that behind a minimal transport interface so
+the node logic (router/processor/sync) is transport-agnostic:
+
+* ``InMemoryHub`` — a process-local mesh connecting N ``Peer``s:
+  gossip fan-out by topic subscription, direct req/resp calls, message
+  dedup by content id, and deterministic delivery (messages deliver in
+  publish order when ``deliver_pending`` runs). This is the testing/
+  simulator transport AND the model for a future real libp2p bridge —
+  the eth2 gossip mesh semantics (subscribe/publish/dedup/score) are
+  all here.
+
+Wire format is production: payloads entering the hub are the
+ssz_snappy bytes produced by ``PubsubMessage.encode`` / rpc codecs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from .gossip import message_id
+from . import snappy
+
+
+@dataclass
+class _GossipDelivery:
+    topic: str
+    msg_id: bytes
+    wire: bytes
+    source: str
+
+
+class Peer:
+    """One node's handle onto the hub (the `NetworkGlobals` + swarm pair)."""
+
+    def __init__(self, hub: "InMemoryHub", peer_id: str):
+        self.hub = hub
+        self.peer_id = peer_id
+        self.subscriptions: set[str] = set()
+        self.inbox: deque[_GossipDelivery] = deque()
+        self.seen_ids: set[bytes] = set()
+        # protocol -> fn(peer_id, request_wire) -> list[response chunks]
+        self.rpc_handlers: dict[str, Callable] = {}
+        self.on_gossip: Callable | None = None  # fn(topic, msg_id, wire, source)
+
+    # ---------------------------------------------------------------- gossip
+    def subscribe(self, topic: str) -> None:
+        self.subscriptions.add(str(topic))
+
+    def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.discard(str(topic))
+
+    def publish(self, topic: str, wire: bytes) -> bytes:
+        """Publish ssz_snappy bytes; returns the message id."""
+        mid = message_id(snappy.decompress(wire))
+        self.seen_ids.add(mid)  # don't re-deliver our own message
+        self.hub.route_gossip(str(topic), mid, wire, self.peer_id)
+        return mid
+
+    # ------------------------------------------------------------------- rpc
+    def register_rpc(self, protocol: str, handler: Callable) -> None:
+        self.rpc_handlers[protocol] = handler
+
+    def request(self, target_peer: str, protocol: str, request_wire: bytes):
+        """Send a req/resp request; returns the responder's chunks."""
+        return self.hub.route_request(
+            self.peer_id, target_peer, protocol, request_wire
+        )
+
+    # -------------------------------------------------------------- delivery
+    def deliver_pending(self) -> int:
+        """Deterministically hand queued gossip to ``on_gossip``."""
+        n = 0
+        while self.inbox:
+            d = self.inbox.popleft()
+            if self.on_gossip is not None:
+                self.on_gossip(d.topic, d.msg_id, d.wire, d.source)
+            n += 1
+        return n
+
+
+class InMemoryHub:
+    """A full mesh of Peers with content-id dedup (gossipsub semantics)."""
+
+    def __init__(self):
+        self.peers: dict[str, Peer] = {}
+        self.banned_links: set[tuple[str, str]] = set()
+        self.messages_routed = 0
+
+    def join(self, peer_id: str) -> Peer:
+        if peer_id in self.peers:
+            raise ValueError(f"duplicate peer id {peer_id!r}")
+        peer = Peer(self, peer_id)
+        self.peers[peer_id] = peer
+        return peer
+
+    def leave(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+
+    def ban_link(self, a: str, b: str) -> None:
+        """Sever delivery both ways (peer-ban / partition simulation)."""
+        self.banned_links.add((a, b))
+        self.banned_links.add((b, a))
+
+    def heal_link(self, a: str, b: str) -> None:
+        self.banned_links.discard((a, b))
+        self.banned_links.discard((b, a))
+
+    # --------------------------------------------------------------- routing
+    def route_gossip(self, topic: str, msg_id: bytes, wire: bytes, source: str):
+        for peer_id, peer in self.peers.items():
+            if peer_id == source:
+                continue
+            if (source, peer_id) in self.banned_links:
+                continue
+            if topic not in peer.subscriptions:
+                continue
+            if msg_id in peer.seen_ids:
+                continue
+            peer.seen_ids.add(msg_id)
+            peer.inbox.append(_GossipDelivery(topic, msg_id, wire, source))
+            self.messages_routed += 1
+
+    def route_request(self, source: str, target: str, protocol: str, wire: bytes):
+        if (source, target) in self.banned_links:
+            raise ConnectionError(f"link {source}->{target} severed")
+        peer = self.peers.get(target)
+        if peer is None:
+            raise ConnectionError(f"unknown peer {target!r}")
+        handler = peer.rpc_handlers.get(protocol)
+        if handler is None:
+            raise ConnectionError(f"{target!r} does not speak {protocol!r}")
+        return handler(source, wire)
+
+    def deliver_all(self, max_rounds: int = 64) -> int:
+        """Run gossip delivery to quiescence: a delivery may trigger
+        re-publishes, so iterate rounds until no peer has pending mail."""
+        total = 0
+        for _ in range(max_rounds):
+            delivered = sum(p.deliver_pending() for p in self.peers.values())
+            if delivered == 0:
+                return total
+            total += delivered
+        return total

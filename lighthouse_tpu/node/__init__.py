@@ -1,0 +1,12 @@
+"""Node composition (reference: beacon_node/client ClientBuilder +
+beacon_node/src ProductionBeaconNode + beacon_node/timer + notifier).
+
+``ClientBuilder`` wires store → slasher → chain → network → http api →
+timer/notifier in the reference's order (builder.rs:130-604);
+``BeaconNode`` is the built product with deterministic ``tick()``
+driving (tests/simulator) or thread-driven ``start()`` (production).
+"""
+
+from .builder import BeaconNode, ClientBuilder, ClientConfig
+
+__all__ = ["BeaconNode", "ClientBuilder", "ClientConfig"]

@@ -113,6 +113,20 @@ class OperationPool:
     def num_attestations(self) -> int:
         return sum(len(v) for v in self.attestations.values())
 
+    def all_attestations(self) -> list:
+        """Every pooled aggregation as an Attestation container (the
+        Beacon-API pool listing)."""
+        att_cls = spec_types(self.spec.preset).Attestation
+        return [
+            att_cls(
+                aggregation_bits=list(entry.bits),
+                data=entry.data,
+                signature=entry.signature.to_bytes(),
+            )
+            for entries in self.attestations.values()
+            for entry in entries
+        ]
+
     def get_attestations(self, state, caches: dict | None = None) -> list:
         """Pack up to MAX_ATTESTATIONS via max-cover over fresh attesters
         (reference: operation_pool/src/lib.rs get_attestations)."""

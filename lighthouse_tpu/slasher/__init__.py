@@ -1,0 +1,21 @@
+"""Slasher — network-wide slashing detection (reference: slasher/ +
+slasher/service, 3.5k LoC on MDBX + zlib).
+
+Unlike the gossip-path observation sets (which only dedup what this
+node has itself verified), the slasher ingests *every* attestation and
+block it sees and detects, across the whole validator registry:
+
+* attester double votes      — same target epoch, different data;
+* attester surround votes    — via min/max-target chunked arrays
+  (the "flat layout" design: 2D epoch×validator chunks, compressed);
+* proposer double proposals  — (slot, proposer) → signing_root map.
+
+Found slashings feed the operation pool so they land in blocks
+(slasher/service). Storage is a column-oriented KV (our C++ engine or
+MemoryStore) with zlib-compressed chunk values — the same shape the
+reference puts on MDBX.
+"""
+
+from .slasher import Slasher, SlasherConfig
+
+__all__ = ["Slasher", "SlasherConfig"]

@@ -1,0 +1,166 @@
+"""Min/max-target chunked arrays (reference: slasher/src/array.rs).
+
+The surround-vote check needs, for every validator and every source
+epoch e, the minimum and maximum attestation target the validator has
+ever attested with source >= e (min-targets) / source <= e
+(max-targets). The reference's "flat layout": the (validator, epoch)
+plane is tiled into chunks of ``validator_chunk_size`` validators ×
+``chunk_size`` epochs; each chunk is a little-endian u16-distance array,
+zlib-compressed in the DB, updated in place as attestations arrive.
+
+Distances are stored relative to the epoch (`target - epoch` for max,
+and saturating for min) so u16 suffices (the reference stores u16
+distances the same way).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+MAX_DISTANCE = 0xFFFF
+
+
+def _col(name: str) -> bytes:
+    return name.encode()
+
+
+class ChunkedArray:
+    """One plane (min or max) of the epoch×validator distance grid."""
+
+    #: min plane: default distance is "infinite" (no attestation yet)
+    #: max plane: default 0 (never attested beyond its own epoch)
+    def __init__(self, db, column: bytes, chunk_size: int,
+                 validator_chunk_size: int, default: int):
+        self.db = db
+        self.column = column
+        self.chunk_size = chunk_size
+        self.validator_chunk_size = validator_chunk_size
+        self.default = default
+        self._cache: dict[tuple[int, int], list[int]] = {}
+        self._dirty: set[tuple[int, int]] = set()
+
+    # ------------------------------------------------------------- chunk io
+    def _key(self, validator_chunk: int, epoch_chunk: int) -> bytes:
+        return validator_chunk.to_bytes(4, "big") + epoch_chunk.to_bytes(4, "big")
+
+    def _load(self, validator_chunk: int, epoch_chunk: int) -> list[int]:
+        key = (validator_chunk, epoch_chunk)
+        chunk = self._cache.get(key)
+        if chunk is not None:
+            return chunk
+        raw = self.db.get(self.column, self._key(*key))
+        n = self.chunk_size * self.validator_chunk_size
+        if raw is None:
+            chunk = [self.default] * n
+        else:
+            data = zlib.decompress(raw)
+            chunk = [
+                int.from_bytes(data[i * 2 : i * 2 + 2], "little")
+                for i in range(n)
+            ]
+        self._cache[key] = chunk
+        return chunk
+
+    def flush(self) -> None:
+        for key in self._dirty:
+            chunk = self._cache[key]
+            data = b"".join(v.to_bytes(2, "little") for v in chunk)
+            self.db.put(self.column, self._key(*key), zlib.compress(data, 1))
+        self._dirty.clear()
+
+    # ------------------------------------------------------------ accessors
+    def _index(self, validator: int, epoch: int) -> tuple[tuple[int, int], int]:
+        vc, vi = divmod(validator, self.validator_chunk_size)
+        ec, ei = divmod(epoch, self.chunk_size)
+        return (vc, ec), vi * self.chunk_size + ei
+
+    def get(self, validator: int, epoch: int) -> int:
+        key, idx = self._index(validator, epoch)
+        return self._load(*key)[idx]
+
+    def set(self, validator: int, epoch: int, value: int) -> None:
+        key, idx = self._index(validator, epoch)
+        chunk = self._load(*key)
+        if chunk[idx] != value:
+            chunk[idx] = value
+            self._dirty.add(key)
+
+
+class TargetArrays:
+    """The pair of planes + the surround logic (array.rs apply_attestation).
+
+    For an attestation (source s, target t) by validator v:
+
+    * it SURROUNDS an earlier vote iff some prior (s', t') has s' > s and
+      t' < t  →  check ``max_target(v, s+1) < t`` is violated, i.e. an
+      existing max-target entry at epoch s+1 lies strictly inside (s, t);
+    * it IS SURROUNDED by an earlier vote iff some prior (s', t') has
+      s' < s and t' > t  →  check via min-targets at epoch s-1… stored as
+      distances.
+
+    Updates then extend both planes over the affected epoch ranges.
+    ``history_length`` bounds how far back epochs are tracked (reference
+    default 4096).
+    """
+
+    def __init__(self, db, chunk_size: int, validator_chunk_size: int,
+                 history_length: int):
+        self.history_length = history_length
+        self.chunk_size = chunk_size
+        self.min_targets = ChunkedArray(
+            db, _col("slasher/min_targets"), chunk_size, validator_chunk_size,
+            default=MAX_DISTANCE,
+        )
+        self.max_targets = ChunkedArray(
+            db, _col("slasher/max_targets"), chunk_size, validator_chunk_size,
+            default=0,
+        )
+
+    # ------------------------------------------------------------- distances
+    # min plane at epoch e: min over recorded votes with source' >= e of
+    #   (target' - e)   (MAX_DISTANCE = no such vote)
+    # max plane at epoch e: max over recorded votes with source' <= e of
+    #   (target' - e)   (0 = no such vote reaching past e)
+    # Epochs index a ring of size history_length — valid while the live
+    # attestation window (weak-subjectivity period) stays well inside it,
+    # the same bound the reference enforces by pruning.
+
+    def check_surround(self, validator: int, source: int, target: int):
+        """Does (source, target) create a surround pair with any
+        recorded vote? Returns "surrounds" / "surrounded" / None."""
+        # surrounded: a prior vote (s' < source, t' > target).
+        # Read the max plane at e = source-1: covers s' <= source-1 (strict).
+        if source >= 1:
+            e = source - 1
+            d = self.max_targets.get(validator, e % self.history_length)
+            if d != 0 and e + d > target:
+                return "surrounded"
+        # surrounds: a prior vote (s' > source, t' < target).
+        # Read the min plane at e = source+1: covers s' >= source+1 (strict).
+        e = source + 1
+        d = self.min_targets.get(validator, e % self.history_length)
+        if d != MAX_DISTANCE and e + d < target:
+            return "surrounds"
+        return None
+
+    def apply(self, validator: int, source: int, target: int) -> None:
+        """Record the vote in both planes (bounded by history_length)."""
+        # max plane: our vote has s' = source <= e for all e >= source;
+        # distance t - e is meaningful while e <= target.
+        hi = min(target, source + self.history_length - 1)
+        for e in range(source, hi + 1):
+            idx = e % self.history_length
+            d = min(target - e, MAX_DISTANCE - 1)
+            if d > self.max_targets.get(validator, idx):
+                self.max_targets.set(validator, idx, d)
+        # min plane: our vote has s' = source >= e for all e <= source.
+        lo = max(0, source - self.history_length + 1)
+        for e in range(lo, source + 1):
+            idx = e % self.history_length
+            d = min(target - e, MAX_DISTANCE - 1)
+            if d < self.min_targets.get(validator, idx):
+                self.min_targets.set(validator, idx, d)
+
+    def flush(self) -> None:
+        self.min_targets.flush()
+        self.max_targets.flush()

@@ -1,0 +1,20 @@
+"""Test infrastructure (reference: testing/* — simulator, node_test_rig,
+state_transition_vectors).
+
+* ``rig``       — LocalBeaconNode / LocalValidatorClient: full
+  production nodes in-process on ephemeral ports (node_test_rig).
+* ``simulator`` — N beacon nodes + validator clients on one hub,
+  driving slots and asserting liveness invariants: onboarding, block
+  production, justification/finalization (testing/simulator/src/
+  main.rs + checks.rs).
+"""
+
+from .rig import LocalBeaconNode, LocalValidatorClient
+from .simulator import Simulator, SimulatorChecks
+
+__all__ = [
+    "LocalBeaconNode",
+    "LocalValidatorClient",
+    "Simulator",
+    "SimulatorChecks",
+]

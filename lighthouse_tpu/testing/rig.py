@@ -1,0 +1,48 @@
+"""node_test_rig equivalents: production components on ephemeral ports
+(reference: testing/node_test_rig/src/lib.rs:32-228 — LocalBeaconNode /
+LocalValidatorClient wrap the real ProductionBeaconNode / VC).
+"""
+
+from __future__ import annotations
+
+from ..api import BeaconNodeClient
+from ..node import BeaconNode, ClientBuilder, ClientConfig
+from ..validator import SlashingDatabase, ValidatorClient
+
+
+class LocalBeaconNode:
+    """A full BeaconNode on a real ephemeral HTTP port."""
+
+    def __init__(self, spec, hub=None, node_id: str = "local",
+                 validator_count: int = 16, config: ClientConfig | None = None):
+        cfg = config or ClientConfig(validator_count=validator_count)
+        cfg.http_enabled = True
+        builder = (
+            ClientBuilder(cfg, spec).memory_store().interop_genesis()
+        )
+        if hub is not None:
+            builder.network(hub, node_id)
+        self.node: BeaconNode = builder.build()
+        self.spec = spec
+
+    def remote(self) -> BeaconNodeClient:
+        """HTTP client onto this node (node_test_rig remote_node)."""
+        return BeaconNodeClient(url=self.node.http.url)
+
+    def stop(self) -> None:
+        self.node.stop()
+
+
+class LocalValidatorClient:
+    """A ValidatorClient wired to one-or-more local BNs over HTTP."""
+
+    def __init__(self, spec, keys, client_or_fallback,
+                 genesis_validators_root: bytes):
+        self.vc = ValidatorClient(
+            client_or_fallback, spec, genesis_validators_root,
+            slashing_db=SlashingDatabase(),
+        )
+        self.vc.add_validators(keys)
+
+    def run_slot(self, slot: int) -> dict:
+        return self.vc.run_slot(slot)

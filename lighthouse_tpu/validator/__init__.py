@@ -1,0 +1,41 @@
+"""Validator client (reference: validator_client/, 18.3k LoC +
+slashing_protection 3.5k LoC).
+
+* ``slashing_protection`` — EIP-3076 low-watermark guards in SQLite
+  (the reference bundles SQLite the same way).
+* ``keystore``   — EIP-2333 hierarchical key derivation and EIP-2335
+  encrypted keystores (crypto/eth2_key_derivation + eth2_keystore).
+* ``store``      — ValidatorStore: every signature wrapped in slashing
+  protection + doppelganger gating (validator_store.rs:80).
+* ``duties``     — DutiesService: attester/proposer/index polling and
+  selection proofs (duties_service.rs:105).
+* ``services``   — BlockService / AttestationService / the per-slot
+  driver loop (block_service.rs, attestation_service.rs).
+* ``fallback``   — multi-BN failover with health ranking
+  (beacon_node_fallback.rs).
+* ``doppelganger`` — liveness watch refusing to sign while another
+  instance of the key may be active (doppelganger_service.rs).
+"""
+
+from .doppelganger import DoppelgangerService
+from .duties import DutiesService
+from .fallback import BeaconNodeFallback
+from .keystore import Keystore, derive_master_sk, derive_validator_keys
+from .services import AttestationService, BlockService, ValidatorClient
+from .slashing_protection import SlashingDatabase, SlashingError
+from .store import ValidatorStore
+
+__all__ = [
+    "AttestationService",
+    "BeaconNodeFallback",
+    "BlockService",
+    "DoppelgangerService",
+    "DutiesService",
+    "Keystore",
+    "SlashingDatabase",
+    "SlashingError",
+    "ValidatorClient",
+    "ValidatorStore",
+    "derive_master_sk",
+    "derive_validator_keys",
+]

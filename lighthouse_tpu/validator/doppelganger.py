@@ -1,0 +1,47 @@
+"""Doppelganger protection (reference: doppelganger_service.rs).
+
+A freshly-started validator observes the network for
+``DETECTION_EPOCHS`` full epochs before its keys may sign: if any
+attestation by one of its validator indices is seen live in that
+window, another instance is running the same keys and signing stays
+disabled permanently (operator intervention required). The reference
+polls the BN's liveness endpoint per epoch; here the check is fed
+either from that endpoint or directly from observed gossip.
+"""
+
+from __future__ import annotations
+
+DETECTION_EPOCHS = 2
+
+
+class DoppelgangerService:
+    def __init__(self, current_epoch: int = 0):
+        # pubkey -> epoch at which signing unlocks
+        self._unlock_epoch: dict[bytes, int] = {}
+        self._detected: set[bytes] = set()
+        self._epoch = current_epoch
+
+    def register(self, pubkey: bytes) -> None:
+        if pubkey not in self._unlock_epoch:
+            self._unlock_epoch[pubkey] = self._epoch + DETECTION_EPOCHS
+
+    def advance_epoch(self, epoch: int) -> None:
+        self._epoch = max(self._epoch, epoch)
+
+    def observe_liveness(self, pubkey: bytes, epoch: int) -> None:
+        """Report that ``pubkey`` was seen attesting at ``epoch`` by
+        someone other than us (liveness poll / gossip observation)."""
+        if epoch >= self._unlock_epoch.get(pubkey, 0) - DETECTION_EPOCHS:
+            if not self.sign_permitted(pubkey) or epoch < self._unlock_epoch.get(pubkey, 0):
+                self._detected.add(pubkey)
+
+    def sign_permitted(self, pubkey: bytes) -> bool:
+        if pubkey in self._detected:
+            return False
+        unlock = self._unlock_epoch.get(pubkey)
+        if unlock is None:
+            return True  # unregistered keys are not gated
+        return self._epoch >= unlock
+
+    def detected(self) -> set[bytes]:
+        return set(self._detected)

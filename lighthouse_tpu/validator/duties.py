@@ -1,0 +1,169 @@
+"""DutiesService (reference: duties_service.rs:105).
+
+Polls the BN for validator indices (`poll_validator_indices:356`),
+attester duties (`poll_beacon_attesters:444`), and proposer duties
+(`poll_beacon_proposers:741`) for the current and next epoch; computes
+selection proofs up-front so the AttestationService knows which of its
+validators aggregate (is_aggregator is decided the moment duties
+arrive, as in the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..consensus.hashing import hash_bytes
+
+
+@dataclass
+class AttesterDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_length: int
+    committees_at_slot: int
+    validator_committee_index: int
+    selection_proof: bytes | None = None
+    is_aggregator: bool = False
+
+
+@dataclass
+class ProposerDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+
+
+@dataclass
+class _EpochDuties:
+    dependent_root: bytes = b""
+    attesters: dict[int, AttesterDuty] = field(default_factory=dict)  # by validator
+    proposers: list[ProposerDuty] = field(default_factory=list)
+
+
+class DutiesService:
+    def __init__(self, client_or_fallback, store, spec):
+        self.client = client_or_fallback  # BeaconNodeClient or BeaconNodeFallback
+        self.store = store
+        self.spec = spec
+        self._attesters: dict[int, _EpochDuties] = {}  # epoch -> duties
+        self._proposers: dict[int, _EpochDuties] = {}
+
+    def _call(self, op):
+        if hasattr(self.client, "first_success"):
+            return self.client.first_success(op)
+        return op(self.client)
+
+    # ---------------------------------------------------------------- polling
+    def poll_validator_indices(self) -> int:
+        """Resolve unknown validator indices by pubkey
+        (poll_validator_indices:356). Returns how many are now known."""
+        known = 0
+        for pubkey in self.store.voting_pubkeys():
+            if self.store.index_of(pubkey) is not None:
+                known += 1
+                continue
+            try:
+                data = self._call(
+                    lambda c: c.get_validator("0x" + pubkey.hex())
+                )["data"]
+            except Exception:
+                continue
+            self.store.set_index(pubkey, int(data["index"]))
+            known += 1
+        return known
+
+    def poll(self, current_epoch: int) -> None:
+        """Refresh duties for current and next epoch."""
+        self.poll_validator_indices()
+        for epoch in (current_epoch, current_epoch + 1):
+            self._poll_attesters(epoch)
+            self._poll_proposers(epoch)
+        # drop stale epochs
+        for m in (self._attesters, self._proposers):
+            for e in [e for e in m if e < current_epoch - 1]:
+                del m[e]
+
+    def _poll_attesters(self, epoch: int) -> None:
+        indices = [
+            self.store.index_of(pk)
+            for pk in self.store.voting_pubkeys()
+            if self.store.index_of(pk) is not None
+        ]
+        if not indices:
+            return
+        resp = self._call(lambda c: c.post_attester_duties(epoch, indices))
+        dependent_root = bytes.fromhex(
+            resp.get("dependent_root", "0x").removeprefix("0x")
+        )
+        cached = self._attesters.get(epoch)
+        if cached is not None and cached.dependent_root == dependent_root:
+            return  # shuffling unchanged (re-org guard, duties_service.rs)
+        duties = _EpochDuties(dependent_root=dependent_root)
+        fork = self._fork()
+        for d in resp["data"]:
+            pubkey = bytes.fromhex(d["pubkey"].removeprefix("0x"))
+            duty = AttesterDuty(
+                pubkey=pubkey,
+                validator_index=int(d["validator_index"]),
+                slot=int(d["slot"]),
+                committee_index=int(d["committee_index"]),
+                committee_length=int(d["committee_length"]),
+                committees_at_slot=int(d["committees_at_slot"]),
+                validator_committee_index=int(d["validator_committee_index"]),
+            )
+            # selection proof now, aggregator decision with it
+            proof = self.store.sign_selection_proof(pubkey, duty.slot, fork)
+            duty.selection_proof = proof
+            duty.is_aggregator = self._is_aggregator(
+                duty.committee_length, proof
+            )
+            duties.attesters[duty.validator_index] = duty
+        self._attesters[epoch] = duties
+
+    def _poll_proposers(self, epoch: int) -> None:
+        resp = self._call(lambda c: c.get_proposer_duties(epoch))
+        dependent_root = bytes.fromhex(
+            resp.get("dependent_root", "0x").removeprefix("0x")
+        )
+        duties = _EpochDuties(dependent_root=dependent_root)
+        ours = {
+            self.store.index_of(pk): pk
+            for pk in self.store.voting_pubkeys()
+            if self.store.index_of(pk) is not None
+        }
+        for d in resp["data"]:
+            vi = int(d["validator_index"])
+            if vi in ours:
+                duties.proposers.append(
+                    ProposerDuty(ours[vi], vi, int(d["slot"]))
+                )
+        self._proposers[epoch] = duties
+
+    def _fork(self):
+        from ..api.json_codec import container_from_json
+        from ..consensus.types import Fork
+
+        data = self._call(lambda c: c.get_state_fork())["data"]
+        return container_from_json(Fork, data)
+
+    def _is_aggregator(self, committee_length: int, proof: bytes) -> bool:
+        from ..consensus.helpers import is_aggregator
+
+        return is_aggregator(committee_length, proof, self.spec)
+
+    # ----------------------------------------------------------------- access
+    def attester_duties_at_slot(self, slot: int) -> list[AttesterDuty]:
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        duties = self._attesters.get(epoch)
+        if duties is None:
+            return []
+        return [d for d in duties.attesters.values() if d.slot == slot]
+
+    def proposer_duties_at_slot(self, slot: int) -> list[ProposerDuty]:
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        duties = self._proposers.get(epoch)
+        if duties is None:
+            return []
+        return [d for d in duties.proposers if d.slot == slot]

@@ -1,0 +1,47 @@
+"""Multi-BN failover (reference: beacon_node_fallback.rs).
+
+The validator client holds N BeaconNodeClients ranked by health; every
+request walks the ranking and fails over on error. Health combines
+reachability and sync distance, re-evaluated on demand (the reference
+polls on a timer; here ``rank()`` runs before each walk).
+"""
+
+from __future__ import annotations
+
+from ..api.beacon_api import ApiError
+
+
+class CandidateError(Exception):
+    """All candidates failed."""
+
+
+class BeaconNodeFallback:
+    def __init__(self, clients: list):
+        if not clients:
+            raise ValueError("at least one beacon node required")
+        self.clients = list(clients)
+
+    def _health(self, client) -> tuple[int, int]:
+        """(tier, sync_distance): lower is better. Tier 0 = synced,
+        1 = syncing, 2 = unreachable."""
+        try:
+            sync = client.node_syncing()["data"]
+        except (ApiError, OSError, ConnectionError):
+            return (2, 1 << 30)
+        distance = int(sync.get("sync_distance", 0))
+        return (1 if sync.get("is_syncing") else 0, distance)
+
+    def rank(self) -> list:
+        return sorted(self.clients, key=self._health)
+
+    def first_success(self, op):
+        """Run ``op(client)`` against candidates in health order,
+        returning the first success (beacon_node_fallback.rs
+        first_success)."""
+        last_error: Exception | None = None
+        for client in self.rank():
+            try:
+                return op(client)
+            except (ApiError, OSError, ConnectionError) as e:
+                last_error = e
+        raise CandidateError(f"all beacon nodes failed: {last_error}")

@@ -1,0 +1,229 @@
+"""EIP-2333 key derivation + EIP-2335 encrypted keystores.
+
+Capability mirror of `crypto/eth2_key_derivation` (derive_master_sk,
+`src/derived_key.rs:55-72`) and `crypto/eth2_keystore` (scrypt/pbkdf2 +
+AES-128-CTR with the SHA-256 checksum construction). The derivation
+math follows the EIP texts directly:
+
+* ``derive_master_sk``  — HKDF-mod-r over the seed with the lamport
+  two-level expansion (hkdf_mod_r / parent_SK_to_lamport_PK).
+* ``derive_child_sk``   — hardened-free EIP-2333 child derivation.
+* ``path m/12381/3600/i/0/0`` — the EIP-2334 validator signing path
+  (``derive_validator_keys``).
+* ``Keystore``          — EIP-2335 JSON: encrypt/decrypt a 32-byte
+  secret under scrypt (stdlib hashlib) or pbkdf2, AES-128-CTR
+  (the `cryptography` package, present in this image).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import unicodedata
+import uuid
+
+from ..consensus.hashing import hash_bytes
+from ..crypto.bls.api import SecretKey
+from ..crypto.bls.constants import R as CURVE_ORDER
+
+# ------------------------------------------------------------------ EIP-2333
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def _hkdf_mod_r(ikm: bytes, key_info: bytes = b"") -> int:
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    sk = 0
+    while sk == 0:
+        salt = hash_bytes(salt)
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + (48).to_bytes(2, "big"), 48)
+        sk = int.from_bytes(okm, "big") % CURVE_ORDER
+    return sk
+
+
+def _ikm_to_lamport_sk(ikm: bytes, salt: bytes) -> list[bytes]:
+    prk = _hkdf_extract(salt, ikm)
+    okm = _hkdf_expand(prk, b"", 255 * 32)
+    return [okm[i * 32 : (i + 1) * 32] for i in range(255)]
+
+
+def _parent_sk_to_lamport_pk(parent_sk: int, index: int) -> bytes:
+    salt = index.to_bytes(4, "big")
+    ikm = parent_sk.to_bytes(32, "big")
+    lamport_0 = _ikm_to_lamport_sk(ikm, salt)
+    not_ikm = bytes(b ^ 0xFF for b in ikm)
+    lamport_1 = _ikm_to_lamport_sk(not_ikm, salt)
+    combined = b"".join(hash_bytes(x) for x in lamport_0 + lamport_1)
+    return hash_bytes(combined)
+
+
+def derive_master_sk(seed: bytes) -> int:
+    if len(seed) < 32:
+        raise ValueError("seed must be at least 32 bytes")
+    return _hkdf_mod_r(seed)
+
+
+def derive_child_sk(parent_sk: int, index: int) -> int:
+    return _hkdf_mod_r(_parent_sk_to_lamport_pk(parent_sk, index))
+
+
+def derive_path(seed: bytes, path: str) -> int:
+    """EIP-2334 path string, e.g. ``m/12381/3600/0/0/0``."""
+    parts = path.strip().split("/")
+    if parts[0] != "m":
+        raise ValueError("path must start at the master node 'm'")
+    sk = derive_master_sk(seed)
+    for p in parts[1:]:
+        sk = derive_child_sk(sk, int(p))
+    return sk
+
+
+def derive_validator_keys(seed: bytes, index: int) -> tuple[SecretKey, SecretKey]:
+    """(signing, withdrawal) keys for validator ``index`` per EIP-2334:
+    signing m/12381/3600/i/0/0, withdrawal m/12381/3600/i/0."""
+    withdrawal = derive_path(seed, f"m/12381/3600/{index}/0")
+    signing = derive_child_sk(withdrawal, 0)
+    return SecretKey.from_int(signing), SecretKey.from_int(withdrawal)
+
+
+# ------------------------------------------------------------------ EIP-2335
+
+
+def _aes_128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def _normalize_password(password: str) -> bytes:
+    # NFKD normalize and strip C0/C1 control codes (EIP-2335 §password)
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(
+        c for c in norm if not (ord(c) < 0x20 or 0x7F <= ord(c) < 0xA0)
+    ).encode("utf-8")
+
+
+class Keystore:
+    """EIP-2335 keystore: JSON in/out, scrypt or pbkdf2 KDF."""
+
+    def __init__(self, crypto: dict, pubkey: str, path: str = "",
+                 description: str = "", uuid_str: str | None = None):
+        self.crypto = crypto
+        self.pubkey = pubkey
+        self.path = path
+        self.description = description
+        self.uuid = uuid_str or str(uuid.uuid4())
+        self.version = 4
+
+    # ----------------------------------------------------------------- build
+    @classmethod
+    def encrypt(
+        cls,
+        secret: SecretKey,
+        password: str,
+        path: str = "",
+        kdf: str = "scrypt",
+    ) -> "Keystore":
+        pw = _normalize_password(password)
+        salt = os.urandom(32)
+        if kdf == "scrypt":
+            dk = hashlib.scrypt(pw, salt=salt, n=2**18, r=8, p=1, dklen=32,
+                                maxmem=2**31 - 1)
+            kdf_module = {
+                "function": "scrypt",
+                "params": {"dklen": 32, "n": 2**18, "r": 8, "p": 1,
+                           "salt": salt.hex()},
+                "message": "",
+            }
+        elif kdf == "pbkdf2":
+            dk = hashlib.pbkdf2_hmac("sha256", pw, salt, 262144, dklen=32)
+            kdf_module = {
+                "function": "pbkdf2",
+                "params": {"dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                           "salt": salt.hex()},
+                "message": "",
+            }
+        else:
+            raise ValueError(f"unsupported kdf {kdf!r}")
+        iv = os.urandom(16)
+        secret_bytes = secret.to_bytes()
+        ciphertext = _aes_128_ctr(dk[:16], iv, secret_bytes)
+        checksum = hash_bytes(dk[16:32] + ciphertext)
+        crypto = {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": ciphertext.hex(),
+            },
+        }
+        pubkey = secret.public_key().to_bytes().hex()
+        return cls(crypto, pubkey, path=path)
+
+    def decrypt(self, password: str) -> SecretKey:
+        pw = _normalize_password(password)
+        kdf = self.crypto["kdf"]
+        salt = bytes.fromhex(kdf["params"]["salt"])
+        if kdf["function"] == "scrypt":
+            p = kdf["params"]
+            dk = hashlib.scrypt(pw, salt=salt, n=p["n"], r=p["r"], p=p["p"],
+                                dklen=p["dklen"], maxmem=2**31 - 1)
+        elif kdf["function"] == "pbkdf2":
+            p = kdf["params"]
+            dk = hashlib.pbkdf2_hmac("sha256", pw, salt, p["c"],
+                                     dklen=p["dklen"])
+        else:
+            raise ValueError(f"unsupported kdf {kdf['function']!r}")
+        ciphertext = bytes.fromhex(self.crypto["cipher"]["message"])
+        checksum = hash_bytes(dk[16:32] + ciphertext)
+        if checksum.hex() != self.crypto["checksum"]["message"]:
+            raise ValueError("invalid password (checksum mismatch)")
+        iv = bytes.fromhex(self.crypto["cipher"]["params"]["iv"])
+        return SecretKey.from_bytes(_aes_128_ctr(dk[:16], iv, ciphertext))
+
+    # ------------------------------------------------------------------ json
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "crypto": self.crypto,
+                "description": self.description,
+                "pubkey": self.pubkey,
+                "path": self.path,
+                "uuid": self.uuid,
+                "version": self.version,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, data: str | dict) -> "Keystore":
+        if isinstance(data, str):
+            data = json.loads(data)
+        if data.get("version") != 4:
+            raise ValueError("unsupported keystore version")
+        return cls(
+            data["crypto"],
+            data.get("pubkey", ""),
+            path=data.get("path", ""),
+            description=data.get("description", ""),
+            uuid_str=data.get("uuid"),
+        )

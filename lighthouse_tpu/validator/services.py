@@ -1,0 +1,213 @@
+"""Validator services: block proposal, attestation, aggregation, and
+the per-slot driver (reference: block_service.rs, attestation_service.rs,
+validator_client/src/lib.rs service wiring).
+
+The reference schedules on wall-clock fractions of a slot (propose at
+slot start, attest at 1/3, aggregate at 2/3). This client keeps those
+as three phases of ``run_slot`` driven by whoever owns the clock (the
+node's timer, the simulator, or a test) — deterministic, no sleeping.
+"""
+
+from __future__ import annotations
+
+from ..api.beacon_api import ApiError
+from ..api.json_codec import container_from_json, container_to_json
+from ..consensus.types import spec_types
+from .duties import DutiesService
+from .slashing_protection import SlashingError
+from .store import ValidatorStore
+
+
+class BlockService:
+    """Propose blocks for scheduled validators (block_service.rs)."""
+
+    def __init__(self, client, store: ValidatorStore, duties: DutiesService, spec):
+        self.client = client
+        self.store = store
+        self.duties = duties
+        self.spec = spec
+        self.types = spec_types(spec.preset)
+        self.blocks_proposed = 0
+
+    def _call(self, op):
+        if hasattr(self.client, "first_success"):
+            return self.client.first_success(op)
+        return op(self.client)
+
+    def propose(self, slot: int) -> list[bytes]:
+        """If one of ours proposes at ``slot``: randao → produce → sign
+        → publish. Returns block roots proposed."""
+        roots = []
+        fork = self.duties._fork()
+        p = self.spec.preset
+        for duty in self.duties.proposer_duties_at_slot(slot):
+            epoch = slot // p.SLOTS_PER_EPOCH
+            reveal = self.store.randao_reveal(duty.pubkey, epoch, fork)
+            produced = self._call(
+                lambda c: c.produce_block(slot, "0x" + reveal.hex())
+            )
+            fork_name = produced.get("version", "phase0")
+            block_cls = self.types.BLOCK_BY_FORK[fork_name]
+            block = container_from_json(block_cls, produced["data"])
+            try:
+                signature = self.store.sign_block(duty.pubkey, block, fork)
+            except SlashingError:
+                continue  # refuse to equivocate
+            signed_cls = self.types.SIGNED_BLOCK_BY_FORK[fork_name]
+            signed = signed_cls(message=block, signature=signature)
+            self._call(
+                lambda c: c.publish_block(container_to_json(signed))
+            )
+            self.blocks_proposed += 1
+            roots.append(block.hash_tree_root())
+        return roots
+
+
+class AttestationService:
+    """Attest at slot+1/3, aggregate at slot+2/3 (attestation_service.rs)."""
+
+    def __init__(self, client, store: ValidatorStore, duties: DutiesService, spec):
+        self.client = client
+        self.store = store
+        self.duties = duties
+        self.spec = spec
+        self.types = spec_types(spec.preset)
+        self.attestations_published = 0
+        self.aggregates_published = 0
+
+    def _call(self, op):
+        if hasattr(self.client, "first_success"):
+            return self.client.first_success(op)
+        return op(self.client)
+
+    def attest(self, slot: int) -> int:
+        """Download one AttestationData per committee, sign per duty,
+        publish the batch. Returns attestations published."""
+        duties = self.duties.attester_duties_at_slot(slot)
+        if not duties:
+            return 0
+        fork = self.duties._fork()
+        data_by_committee: dict[int, object] = {}
+        out = []
+        for duty in duties:
+            ci = duty.committee_index
+            if ci not in data_by_committee:
+                resp = self._call(
+                    lambda c: c.attestation_data(slot, ci)
+                )["data"]
+                from ..consensus.types import AttestationData
+
+                data_by_committee[ci] = container_from_json(AttestationData, resp)
+            data = data_by_committee[ci]
+            try:
+                signature = self.store.sign_attestation(duty.pubkey, data, fork)
+            except SlashingError:
+                continue
+            bits = [False] * duty.committee_length
+            bits[duty.validator_committee_index] = True
+            out.append(
+                self.types.Attestation(
+                    aggregation_bits=bits, data=data, signature=signature
+                )
+            )
+        if out:
+            self._call(
+                lambda c: c.post_pool_attestations(
+                    [container_to_json(a) for a in out]
+                )
+            )
+            self.attestations_published += len(out)
+        return len(out)
+
+    def aggregate(self, slot: int) -> int:
+        """For each of our aggregators: fetch the naive-pool aggregate,
+        wrap in SignedAggregateAndProof, publish."""
+        duties = [
+            d
+            for d in self.duties.attester_duties_at_slot(slot)
+            if d.is_aggregator
+        ]
+        if not duties:
+            return 0
+        fork = self.duties._fork()
+        published = 0
+        for duty in duties:
+            resp = self._call(
+                lambda c: c.attestation_data(slot, duty.committee_index)
+            )["data"]
+            from ..consensus.types import AttestationData
+
+            data = container_from_json(AttestationData, resp)
+            data_root = data.hash_tree_root()
+            try:
+                agg = self._call(
+                    lambda c: c.aggregate_attestation(
+                        slot, "0x" + data_root.hex()
+                    )
+                )["data"]
+            except ApiError:
+                continue  # nothing aggregated for this data
+            aggregate = container_from_json(self.types.Attestation, agg)
+            message = self.types.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=duty.selection_proof,
+            )
+            signature = self.store.sign_aggregate_and_proof(
+                duty.pubkey, message, fork
+            )
+            signed = self.types.SignedAggregateAndProof(
+                message=message, signature=signature
+            )
+            try:
+                self._call(
+                    lambda c: c.post_aggregate_and_proofs(
+                        [container_to_json(signed)]
+                    )
+                )
+                published += 1
+            except ApiError:
+                continue  # e.g. someone else's identical aggregate won
+        self.aggregates_published += published
+        return published
+
+
+class ValidatorClient:
+    """The composed client: duties + block + attestation services over
+    one (or fallback-many) BN connections (validator_client/src/lib.rs)."""
+
+    def __init__(self, client, spec, genesis_validators_root: bytes,
+                 slashing_db=None, doppelganger=None):
+        self.spec = spec
+        self.client = client
+        self.store = ValidatorStore(
+            spec, genesis_validators_root, slashing_db, doppelganger
+        )
+        self.duties = DutiesService(client, self.store, spec)
+        self.block_service = BlockService(client, self.store, self.duties, spec)
+        self.attestation_service = AttestationService(
+            client, self.store, self.duties, spec
+        )
+        self._last_polled_epoch: int | None = None
+
+    def add_validators(self, secret_keys) -> None:
+        for sk in secret_keys:
+            self.store.add_validator(sk)
+
+    def run_slot(self, slot: int) -> dict:
+        """One full slot of duty: poll duties on epoch change, propose,
+        attest, aggregate. Returns counters for the slot."""
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        if self._last_polled_epoch != epoch:
+            self.duties.poll(epoch)
+            self._last_polled_epoch = epoch
+            if self.store.doppelganger is not None:
+                self.store.doppelganger.advance_epoch(epoch)
+        proposed = self.block_service.propose(slot)
+        attested = self.attestation_service.attest(slot)
+        aggregated = self.attestation_service.aggregate(slot)
+        return {
+            "proposed": len(proposed),
+            "attested": attested,
+            "aggregated": aggregated,
+        }

@@ -1,0 +1,271 @@
+"""EIP-3076 slashing protection on SQLite.
+
+Capability mirror of `validator_client/slashing_protection/`: before
+any block or attestation signature, record-and-check against the
+per-validator low watermarks — a block may only be signed for a slot
+strictly greater than any previously signed slot, an attestation's
+(source, target) must be non-surrounding and non-surrounded with a
+target strictly beyond the last signed target (the reference enforces
+the same via min/max slot & epoch queries; `src/slashing_database.rs`).
+Includes EIP-3076 interchange import/export
+(`tests/interchange.rs` behavior).
+
+The DB schema matches the reference's shape: validators table keyed by
+pubkey, signed_blocks and signed_attestations keyed by validator id.
+SQLite is in the stdlib here; the reference bundles rusqlite.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+
+GENESIS_VALIDATORS_ROOT_KEY = "genesis_validators_root"
+INTERCHANGE_VERSION = "5"
+
+
+class SlashingError(Exception):
+    """Refusal to sign (reference: NotSafe::Slashable*)."""
+
+
+class SlashingDatabase:
+    def __init__(self, path: str = ":memory:"):
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS validators (
+                id INTEGER PRIMARY KEY,
+                pubkey BLOB UNIQUE NOT NULL
+            );
+            CREATE TABLE IF NOT EXISTS signed_blocks (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                slot INTEGER NOT NULL,
+                signing_root BLOB,
+                UNIQUE (validator_id, slot)
+            );
+            CREATE TABLE IF NOT EXISTS signed_attestations (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                source_epoch INTEGER NOT NULL,
+                target_epoch INTEGER NOT NULL,
+                signing_root BLOB,
+                UNIQUE (validator_id, target_epoch)
+            );
+            CREATE TABLE IF NOT EXISTS metadata (
+                key TEXT PRIMARY KEY,
+                value TEXT
+            );
+            """
+        )
+        self.conn.commit()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    # ------------------------------------------------------------ registration
+    def register_validator(self, pubkey: bytes) -> int:
+        cur = self.conn.execute(
+            "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)", (pubkey,)
+        )
+        self.conn.commit()
+        row = self.conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (pubkey,)
+        ).fetchone()
+        return row[0]
+
+    def _validator_id(self, pubkey: bytes) -> int:
+        row = self.conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (pubkey,)
+        ).fetchone()
+        if row is None:
+            raise SlashingError(f"unregistered validator {pubkey.hex()[:16]}…")
+        return row[0]
+
+    # ----------------------------------------------------------------- blocks
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes = b""
+    ) -> None:
+        """Refuse double/old proposals: slot must exceed every recorded
+        slot, except the exact same (slot, signing_root) is idempotent."""
+        vid = self._validator_id(pubkey)
+        row = self.conn.execute(
+            "SELECT slot, signing_root FROM signed_blocks "
+            "WHERE validator_id = ? AND slot = ?",
+            (vid, slot),
+        ).fetchone()
+        if row is not None:
+            if row[1] == signing_root and signing_root:
+                return  # same block re-signed: safe
+            raise SlashingError(f"double block proposal at slot {slot}")
+        row = self.conn.execute(
+            "SELECT MAX(slot) FROM signed_blocks WHERE validator_id = ?",
+            (vid,),
+        ).fetchone()
+        if row[0] is not None and slot <= row[0]:
+            raise SlashingError(
+                f"block slot {slot} not beyond watermark {row[0]}"
+            )
+        self.conn.execute(
+            "INSERT INTO signed_blocks (validator_id, slot, signing_root) "
+            "VALUES (?, ?, ?)",
+            (vid, slot, signing_root),
+        )
+        self.conn.commit()
+
+    # ----------------------------------------------------------- attestations
+    def check_and_insert_attestation(
+        self,
+        pubkey: bytes,
+        source_epoch: int,
+        target_epoch: int,
+        signing_root: bytes = b"",
+    ) -> None:
+        """EIP-3076 rules: no double vote at a target, no surrounding or
+        surrounded vote, monotone source/target watermarks."""
+        vid = self._validator_id(pubkey)
+        if source_epoch > target_epoch:
+            raise SlashingError("attestation source after target")
+        row = self.conn.execute(
+            "SELECT source_epoch, target_epoch, signing_root FROM "
+            "signed_attestations WHERE validator_id = ? AND target_epoch = ?",
+            (vid, target_epoch),
+        ).fetchone()
+        if row is not None:
+            if row[2] == signing_root and signing_root:
+                return  # identical re-sign
+            raise SlashingError(f"double vote at target {target_epoch}")
+        # surrounding: an existing (s, t) with s > source and t < target
+        row = self.conn.execute(
+            "SELECT source_epoch, target_epoch FROM signed_attestations "
+            "WHERE validator_id = ? AND source_epoch > ? AND target_epoch < ?",
+            (vid, source_epoch, target_epoch),
+        ).fetchone()
+        if row is not None:
+            raise SlashingError(
+                f"surrounding vote: ({source_epoch},{target_epoch}) "
+                f"surrounds ({row[0]},{row[1]})"
+            )
+        # surrounded: an existing (s, t) with s < source and t > target
+        row = self.conn.execute(
+            "SELECT source_epoch, target_epoch FROM signed_attestations "
+            "WHERE validator_id = ? AND source_epoch < ? AND target_epoch > ?",
+            (vid, source_epoch, target_epoch),
+        ).fetchone()
+        if row is not None:
+            raise SlashingError(
+                f"surrounded vote: ({row[0]},{row[1]}) "
+                f"surrounds ({source_epoch},{target_epoch})"
+            )
+        self.conn.execute(
+            "INSERT INTO signed_attestations "
+            "(validator_id, source_epoch, target_epoch, signing_root) "
+            "VALUES (?, ?, ?, ?)",
+            (vid, source_epoch, target_epoch, signing_root),
+        )
+        self.conn.commit()
+
+    # ------------------------------------------------------------ interchange
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        """EIP-3076 interchange JSON (complete format)."""
+        data = []
+        for vid, pubkey in self.conn.execute(
+            "SELECT id, pubkey FROM validators"
+        ).fetchall():
+            blocks = [
+                {"slot": str(slot), "signing_root": "0x" + (sr or b"").hex()}
+                for slot, sr in self.conn.execute(
+                    "SELECT slot, signing_root FROM signed_blocks "
+                    "WHERE validator_id = ? ORDER BY slot",
+                    (vid,),
+                ).fetchall()
+            ]
+            atts = [
+                {
+                    "source_epoch": str(s),
+                    "target_epoch": str(t),
+                    "signing_root": "0x" + (sr or b"").hex(),
+                }
+                for s, t, sr in self.conn.execute(
+                    "SELECT source_epoch, target_epoch, signing_root FROM "
+                    "signed_attestations WHERE validator_id = ? "
+                    "ORDER BY target_epoch",
+                    (vid,),
+                ).fetchall()
+            ]
+            data.append(
+                {
+                    "pubkey": "0x" + pubkey.hex(),
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": INTERCHANGE_VERSION,
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(
+        self, interchange: dict | str, genesis_validators_root: bytes
+    ) -> int:
+        """Merge an interchange file; refuses mismatched genesis roots.
+        Returns number of validators imported."""
+        if isinstance(interchange, str):
+            interchange = json.loads(interchange)
+        meta_root = interchange["metadata"]["genesis_validators_root"]
+        if bytes.fromhex(meta_root.removeprefix("0x")) != genesis_validators_root:
+            raise SlashingError("interchange genesis_validators_root mismatch")
+        count = 0
+        for record in interchange.get("data", []):
+            pubkey = bytes.fromhex(record["pubkey"].removeprefix("0x"))
+            vid = self.register_validator(pubkey)
+            for b in record.get("signed_blocks", []):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO signed_blocks "
+                    "(validator_id, slot, signing_root) VALUES (?, ?, ?)",
+                    (
+                        vid,
+                        int(b["slot"]),
+                        bytes.fromhex(
+                            b.get("signing_root", "0x").removeprefix("0x")
+                        ),
+                    ),
+                )
+            for a in record.get("signed_attestations", []):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO signed_attestations "
+                    "(validator_id, source_epoch, target_epoch, signing_root) "
+                    "VALUES (?, ?, ?, ?)",
+                    (
+                        vid,
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        bytes.fromhex(
+                            a.get("signing_root", "0x").removeprefix("0x")
+                        ),
+                    ),
+                )
+            count += 1
+        self.conn.commit()
+        return count
+
+    # ---------------------------------------------------------------- pruning
+    def prune(self, pubkey: bytes, keep_from_epoch: int, keep_from_slot: int):
+        vid = self._validator_id(pubkey)
+        # keep the watermark rows: delete strictly-older entries only if
+        # newer ones exist
+        self.conn.execute(
+            "DELETE FROM signed_blocks WHERE validator_id = ? AND slot < ? "
+            "AND EXISTS (SELECT 1 FROM signed_blocks WHERE validator_id = ? "
+            "AND slot >= ?)",
+            (vid, keep_from_slot, vid, keep_from_slot),
+        )
+        self.conn.execute(
+            "DELETE FROM signed_attestations WHERE validator_id = ? AND "
+            "target_epoch < ? AND EXISTS (SELECT 1 FROM signed_attestations "
+            "WHERE validator_id = ? AND target_epoch >= ?)",
+            (vid, keep_from_epoch, vid, keep_from_epoch),
+        )
+        self.conn.commit()

@@ -1,0 +1,119 @@
+"""ValidatorStore — every signature goes through here.
+
+Capability mirror of `validator_client/src/validator_store.rs:80`:
+wraps signing with (1) slashing-protection checks, (2) doppelganger
+gating, (3) the correct domain computation per object type
+(randao_reveal:338, sign_block:382, sign_attestation:459). Signing
+methods mirror `signing_method.rs:78`: LocalKeystore (in-process BLS)
+or a remote Web3Signer-style callable.
+"""
+
+from __future__ import annotations
+
+from ..consensus.config import ChainSpec, compute_signing_root
+from ..consensus.ssz import merkleize_chunks, uint64
+from ..crypto.bls.api import SecretKey
+from .slashing_protection import SlashingDatabase, SlashingError
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_validators_root: bytes,
+        slashing_db: SlashingDatabase | None = None,
+        doppelganger=None,
+    ):
+        self.spec = spec
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self.doppelganger = doppelganger
+        # pubkey -> signer; signer is SecretKey or fn(signing_root)->bytes
+        self._signers: dict[bytes, object] = {}
+        self._indices: dict[bytes, int] = {}
+
+    # ---------------------------------------------------------- registration
+    def add_validator(self, signer, validator_index: int | None = None,
+                      pubkey: bytes | None = None) -> bytes:
+        if isinstance(signer, SecretKey):
+            pubkey = signer.public_key().to_bytes()
+        elif pubkey is None:
+            raise ValueError("remote signers need an explicit pubkey")
+        self._signers[pubkey] = signer
+        if validator_index is not None:
+            self._indices[pubkey] = validator_index
+        self.slashing_db.register_validator(pubkey)
+        if self.doppelganger is not None:
+            self.doppelganger.register(pubkey)
+        return pubkey
+
+    def voting_pubkeys(self) -> list[bytes]:
+        return list(self._signers)
+
+    def index_of(self, pubkey: bytes) -> int | None:
+        return self._indices.get(pubkey)
+
+    def set_index(self, pubkey: bytes, index: int) -> None:
+        self._indices[pubkey] = index
+
+    # ---------------------------------------------------------------- signing
+    def _raw_sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        signer = self._signers.get(pubkey)
+        if signer is None:
+            raise KeyError(f"no signer for {pubkey.hex()[:16]}…")
+        if self.doppelganger is not None and not self.doppelganger.sign_permitted(pubkey):
+            raise SlashingError("doppelganger protection: signing disabled")
+        if isinstance(signer, SecretKey):
+            return signer.sign(signing_root).to_bytes()
+        return signer(signing_root)  # remote / web3signer-style
+
+    def _domain(self, domain_type: bytes, epoch: int, fork) -> bytes:
+        return self.spec.get_domain(
+            domain_type, epoch, fork, self.genesis_validators_root
+        )
+
+    def randao_reveal(self, pubkey: bytes, epoch: int, fork) -> bytes:
+        domain = self._domain(self.spec.DOMAIN_RANDAO, epoch, fork)
+        root = merkleize_chunks([uint64.hash_tree_root(epoch), domain])
+        return self._raw_sign(pubkey, root)
+
+    def sign_block(self, pubkey: bytes, block, fork) -> bytes:
+        p = self.spec.preset
+        epoch = int(block.slot) // p.SLOTS_PER_EPOCH
+        domain = self._domain(self.spec.DOMAIN_BEACON_PROPOSER, epoch, fork)
+        root = compute_signing_root(block, domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, int(block.slot), root
+        )
+        return self._raw_sign(pubkey, root)
+
+    def sign_attestation(self, pubkey: bytes, data, fork) -> bytes:
+        domain = self._domain(
+            self.spec.DOMAIN_BEACON_ATTESTER, int(data.target.epoch), fork
+        )
+        root = compute_signing_root(data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, int(data.source.epoch), int(data.target.epoch), root
+        )
+        return self._raw_sign(pubkey, root)
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int, fork) -> bytes:
+        p = self.spec.preset
+        epoch = slot // p.SLOTS_PER_EPOCH
+        domain = self._domain(self.spec.DOMAIN_SELECTION_PROOF, epoch, fork)
+        root = merkleize_chunks([uint64.hash_tree_root(slot), domain])
+        return self._raw_sign(pubkey, root)
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, message, fork) -> bytes:
+        p = self.spec.preset
+        epoch = int(message.aggregate.data.slot) // p.SLOTS_PER_EPOCH
+        domain = self._domain(self.spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, fork)
+        root = compute_signing_root(message, domain)
+        return self._raw_sign(pubkey, root)
+
+    def sign_voluntary_exit(self, pubkey: bytes, exit_msg, fork) -> bytes:
+        domain = self._domain(
+            self.spec.DOMAIN_VOLUNTARY_EXIT, int(exit_msg.epoch), fork
+        )
+        root = compute_signing_root(exit_msg, domain)
+        return self._raw_sign(pubkey, root)

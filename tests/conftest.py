@@ -26,12 +26,15 @@ import resource
 # spawns its compiler threads so they inherit it.
 try:
     _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    # MUST be a finite value: glibc sizes new pthread stacks from the
+    # soft limit ONLY when it is finite — RLIM_INFINITY falls back to
+    # the 8 MB default, which XLA's compiler threads overflow.
     _want = (
-        resource.RLIM_INFINITY
+        512 * 1024 * 1024
         if _hard == resource.RLIM_INFINITY
         else min(_hard, 512 * 1024 * 1024)
     )
-    if _soft != resource.RLIM_INFINITY and (_want == resource.RLIM_INFINITY or _soft < _want):
+    if _soft == resource.RLIM_INFINITY or _soft < _want:
         resource.setrlimit(resource.RLIMIT_STACK, (_want, _hard))
 except (ValueError, OSError):
     pass

@@ -1,0 +1,111 @@
+"""Commons tests: metrics registry/exposition, task executor lifecycle,
+structured logging (reference: common/lighthouse_metrics,
+common/task_executor, common/logging)."""
+
+import io
+import threading
+import time
+
+from lighthouse_tpu.common.metrics import Registry
+from lighthouse_tpu.common.logging import NullLogger, StructuredLogger
+from lighthouse_tpu.common.task_executor import ShutdownSignal, TaskExecutor
+
+
+class TestMetrics:
+    def test_counter_and_gauge(self):
+        r = Registry()
+        c = r.counter("requests_total", "Requests", ("route",))
+        c.inc(route="/genesis")
+        c.inc(2, route="/genesis")
+        g = r.gauge("queue_depth", "Depth")
+        g.set(7)
+        g.dec()
+        assert c.value(route="/genesis") == 3
+        assert g.value() == 6
+        text = r.gather()
+        assert 'requests_total{route="/genesis"} 3.0' in text
+        assert "queue_depth 6.0" in text
+        assert "# TYPE requests_total counter" in text
+
+    def test_histogram_buckets_and_timer(self):
+        r = Registry()
+        h = r.histogram("latency", "L", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = r.gather()
+        assert 'latency_bucket{le="0.1"} 1' in text
+        assert 'latency_bucket{le="1.0"} 2' in text
+        assert 'latency_bucket{le="+Inf"} 3' in text
+        assert "latency_count 3" in text
+        with h.start_timer():
+            pass
+        assert "latency_count 4" in r.gather()
+
+    def test_reregistration_returns_same_metric(self):
+        r = Registry()
+        a = r.counter("x", "")
+        b = r.counter("x", "")
+        assert a is b
+
+
+class TestTaskExecutor:
+    def test_spawn_and_shutdown(self):
+        ex = TaskExecutor("test")
+        hits = []
+
+        def work(shutdown: ShutdownSignal):
+            while not shutdown.wait(0.005):
+                hits.append(1)
+
+        ex.spawn(work, "worker")
+        time.sleep(0.05)
+        ex.shutdown.trigger("done")
+        reason = ex.block_on_shutdown(timeout=1.0)
+        assert reason == "done"
+        assert hits  # it ran
+
+    def test_crash_triggers_shutdown(self):
+        ex = TaskExecutor("test")
+
+        def boom(shutdown):
+            raise RuntimeError("kaput")
+
+        import sys
+
+        stderr, sys.stderr = sys.stderr, io.StringIO()
+        try:
+            ex.spawn(boom, "boom")
+            assert ex.shutdown.wait(2.0)
+        finally:
+            sys.stderr = stderr
+        assert "crashed" in (ex.shutdown.reason or "")
+
+    def test_periodic(self):
+        ex = TaskExecutor("test")
+        hits = []
+        ex.spawn_periodic(lambda: hits.append(1), 0.01, "tick")
+        time.sleep(0.08)
+        ex.shutdown.trigger()
+        ex.block_on_shutdown(timeout=1.0)
+        assert len(hits) >= 2
+
+
+class TestLogging:
+    def test_structured_format(self):
+        buf = io.StringIO()
+        log = StructuredLogger(stream=buf, level="info")
+        log.info("Block imported", slot=5, root="0xab")
+        log.debug("hidden", x=1)
+        out = buf.getvalue()
+        assert "Block imported, slot: 5, root: 0xab" in out
+        assert "hidden" not in out
+
+    def test_bind_context(self):
+        buf = io.StringIO()
+        log = StructuredLogger(stream=buf, level="info").bind(service="vc")
+        log.warn("late duty", slot=9)
+        assert "service: vc" in buf.getvalue()
+
+    def test_null_logger_silent(self):
+        NullLogger().crit("nothing")  # no exception, no output

@@ -1,0 +1,190 @@
+"""Beacon-API tests: JSON codec round-trips, endpoint handlers (direct),
+and the real HTTP server + typed client end-to-end (reference test
+model: http_api tests over a harness chain)."""
+
+import pytest
+
+from lighthouse_tpu.api import (
+    ApiError,
+    BeaconApi,
+    BeaconNodeClient,
+    HttpServer,
+    container_from_json,
+    container_to_json,
+)
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = BeaconChainHarness(validator_count=16)
+    h.extend_chain(3)
+    return h
+
+
+@pytest.fixture(scope="module")
+def api(harness):
+    return BeaconApi(harness.chain)
+
+
+class TestJsonCodec:
+    def test_attestation_roundtrip(self, harness):
+        att = harness.chain.op_pool.all_attestations()[0]
+        data = container_to_json(att)
+        assert data["data"]["slot"] == str(int(att.data.slot))
+        assert data["signature"].startswith("0x")
+        back = container_from_json(type(att), data)
+        assert back.encode() == att.encode()
+
+    def test_block_roundtrip(self, harness):
+        block = harness.chain.head().block
+        data = container_to_json(block)
+        back = container_from_json(type(block), data)
+        assert back.encode() == block.encode()
+        assert back.message.hash_tree_root() == block.message.hash_tree_root()
+
+
+class TestEndpoints:
+    def test_genesis(self, api, harness):
+        data = api.get_genesis()["data"]
+        assert data["genesis_validators_root"] == (
+            "0x" + harness.chain.genesis_validators_root.hex()
+        )
+
+    def test_state_root_and_fork(self, api, harness):
+        root = api.get_state_root("head")["data"]["root"]
+        assert root == "0x" + harness.chain.head().state.hash_tree_root().hex()
+        fork = api.get_state_fork("head")["data"]
+        assert fork["current_version"].startswith("0x")
+
+    def test_finality_checkpoints(self, api):
+        data = api.get_finality_checkpoints("head")["data"]
+        assert set(data) == {"previous_justified", "current_justified", "finalized"}
+
+    def test_validators(self, api):
+        out = api.get_validators("head")["data"]
+        assert len(out) == 16
+        assert out[3]["status"] == "active_ongoing"
+        one = api.get_validator("head", "3")["data"]
+        assert one["index"] == "3"
+        by_pk = api.get_validator("head", one["validator"]["pubkey"])["data"]
+        assert by_pk["index"] == "3"
+
+    def test_committees(self, api, harness):
+        out = api.get_committees("head")["data"]
+        p = harness.spec.preset
+        assert len(out) >= p.SLOTS_PER_EPOCH  # ≥1 committee per slot
+        sizes = sum(len(c["validators"]) for c in out)
+        assert sizes == 16  # every validator sits in exactly one committee
+
+    def test_headers_and_blocks(self, api, harness):
+        head = harness.chain.head()
+        hdr = api.get_header("head")["data"]
+        assert hdr["root"] == "0x" + head.root.hex()
+        blk = api.get_block("head")
+        assert blk["version"] == "phase0"
+        assert blk["data"]["message"]["slot"] == str(int(head.block.message.slot))
+        root = api.get_block_root("3")["data"]["root"]
+        assert root == "0x" + head.root.hex()  # slot 3 is the head
+        atts = api.get_block_attestations("head")["data"]
+        assert len(atts) == len(head.block.message.body.attestations)
+
+    def test_block_by_slot_and_missing(self, api):
+        blk = api.get_block("1")
+        assert blk["data"]["message"]["slot"] == "1"
+        with pytest.raises(ApiError) as e:
+            api.get_block("99")
+        assert e.value.status == 404
+
+    def test_node_and_config(self, api):
+        assert "lighthouse-tpu" in api.node_version()["data"]["version"]
+        sync = api.node_syncing()["data"]
+        assert sync["is_syncing"] in (False, True)
+        spec = api.config_spec()["data"]
+        assert spec["PRESET_BASE"] == "minimal"
+        sched = api.config_fork_schedule()["data"]
+        assert sched[0]["epoch"] == "0"
+
+    def test_duties(self, api, harness):
+        duties = api.duties_proposer(0)["data"]
+        p = harness.spec.preset
+        assert len(duties) == p.SLOTS_PER_EPOCH
+        att_duties = api.duties_attester(0, list(range(16)))["data"]
+        assert len(att_duties) == 16
+        d = att_duties[0]
+        assert int(d["committee_length"]) >= 1
+        assert d["pubkey"].startswith("0x")
+
+    def test_attestation_data(self, api, harness):
+        slot = harness.chain.current_slot()
+        data = api.attestation_data(slot, 0)["data"]
+        assert data["slot"] == str(slot)
+        assert data["beacon_block_root"] == "0x" + harness.chain.head().root.hex()
+
+    def test_pool_attestations_listing(self, api, harness):
+        out = api.get_pool_attestations()["data"]
+        assert len(out) == harness.chain.op_pool.num_attestations()
+
+    def test_proto_array_introspection(self, api):
+        nodes = api.lighthouse_proto_array()["data"]["nodes"]
+        assert len(nodes) >= 4  # genesis + 3 blocks
+
+
+class TestBlockPublishFlow:
+    def test_produce_sign_publish_via_api(self):
+        harness = BeaconChainHarness(validator_count=16)
+        api = BeaconApi(harness.chain)
+        client = BeaconNodeClient(api=api)
+        slot = harness.advance_slot()
+        duties = client.get_proposer_duties(0)["data"]
+        proposer = next(d for d in duties if d["slot"] == str(slot))
+        produced = client.produce_block(
+            slot, "0x" + (b"\xc0" + bytes(95)).hex()
+        )["data"]
+        block_cls = harness.types.BLOCK_BY_FORK["phase0"]
+        block = container_from_json(block_cls, produced)
+        signed = harness.sign_block(block)
+        client.publish_block(container_to_json(signed))
+        assert int(harness.chain.head().block.message.slot) == slot
+
+
+class TestHttpTransport:
+    @pytest.fixture(scope="class")
+    def server(self):
+        harness = BeaconChainHarness(validator_count=16)
+        harness.extend_chain(2)
+        api = BeaconApi(harness.chain)
+        server = HttpServer(api).start()
+        yield harness, server
+        server.stop()
+
+    def test_get_over_http(self, server):
+        harness, srv = server
+        client = BeaconNodeClient(url=srv.url)
+        genesis = client.get_genesis()["data"]
+        assert genesis["genesis_validators_root"] == (
+            "0x" + harness.chain.genesis_validators_root.hex()
+        )
+        assert client.node_version()["data"]["version"].startswith("lighthouse-tpu")
+        hdr = client.get_header()["data"]
+        assert hdr["root"] == "0x" + harness.chain.head().root.hex()
+
+    def test_post_over_http(self, server):
+        harness, srv = server
+        client = BeaconNodeClient(url=srv.url)
+        duties = client.post_attester_duties(0, [0, 1, 2])["data"]
+        assert len(duties) == 3
+
+    def test_404_maps_to_api_error(self, server):
+        _, srv = server
+        client = BeaconNodeClient(url=srv.url)
+        with pytest.raises(ApiError) as e:
+            client.get_block("0x" + "ab" * 32)
+        assert e.value.status == 404
+
+    def test_health_endpoint(self, server):
+        import urllib.request
+
+        _, srv = server
+        with urllib.request.urlopen(srv.url + "/eth/v1/node/health") as resp:
+            assert resp.status == 200

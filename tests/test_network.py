@@ -1,0 +1,300 @@
+"""Network stack tests: snappy codec, gossip topics/codec, processor
+scheduling, rate limiting, peer scoring, and two/three-node
+gossip+sync integration over the in-memory hub (reference test model:
+network/src/beacon_processor/tests.rs + lighthouse_network tests)."""
+
+import pytest
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network import (
+    BeaconProcessor,
+    GossipTopic,
+    InMemoryHub,
+    NetworkService,
+    PubsubMessage,
+    RateLimiter,
+    WorkEvent,
+    WorkType,
+)
+from lighthouse_tpu.network import gossip as g
+from lighthouse_tpu.network import rpc, snappy
+from lighthouse_tpu.network.peer_manager import PeerAction, PeerManager, PeerStatus
+from lighthouse_tpu.network.sync import SyncState
+
+
+# ------------------------------------------------------------------- snappy
+class TestSnappy:
+    def test_roundtrip_simple(self):
+        for payload in (b"", b"a", b"hello world", bytes(range(256)) * 7):
+            assert snappy.decompress(snappy.compress(payload)) == payload
+
+    def test_roundtrip_compressible(self):
+        payload = b"abcd" * 10_000 + b"the quick brown fox" * 500
+        wire = snappy.compress(payload)
+        assert len(wire) < len(payload) // 2  # actually compresses
+        assert snappy.decompress(wire) == payload
+
+    def test_roundtrip_random(self):
+        import random
+
+        rng = random.Random(7)
+        for size in (1, 63, 64, 65, 4096, 70_000):
+            payload = bytes(rng.randrange(4) for _ in range(size))  # RLE-ish
+            assert snappy.decompress(snappy.compress(payload)) == payload
+
+    def test_truncation_rejected(self):
+        wire = snappy.compress(b"hello world, hello world, hello world")
+        with pytest.raises(ValueError):
+            snappy.decompress(wire[:-3])
+
+    def test_length_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            # declares 100 bytes, contains none
+            snappy.decompress(bytes([100]))
+
+
+# ------------------------------------------------------------------- gossip
+class TestGossip:
+    def test_topic_string_roundtrip(self):
+        t = GossipTopic(b"\x01\x02\x03\x04", "beacon_block")
+        assert str(t) == "/eth2/01020304/beacon_block/ssz_snappy"
+        assert GossipTopic.parse(str(t)) == t
+
+    def test_subnet_topics(self):
+        t = GossipTopic.attestation_subnet(b"\x00" * 4, 13)
+        assert t.subnet_id() == 13
+        assert GossipTopic(b"\x00" * 4, "beacon_block").subnet_id() is None
+
+    def test_message_id_content_addressed(self):
+        a = g.message_id(b"payload")
+        assert len(a) == 20
+        assert a != g.message_id(b"payload2")
+
+    def test_pubsub_attestation_roundtrip(self):
+        harness = BeaconChainHarness(validator_count=16)
+        harness.extend_chain(1, attest=False)
+        att = harness.chain.produce_unaggregated_attestation(1, 0)
+        wire = PubsubMessage(f"{g.BEACON_ATTESTATION_PREFIX}0", att).encode()
+        topic = GossipTopic.attestation_subnet(b"\x00" * 4, 0)
+        decoded = PubsubMessage.decode(
+            topic, wire, harness.chain.types, "phase0"
+        )
+        assert decoded.item.data.slot == att.data.slot
+        assert decoded.item.encode() == att.encode()
+
+    def test_pubsub_block_roundtrip(self):
+        harness = BeaconChainHarness(validator_count=16)
+        harness.advance_slot()
+        block = harness.make_block()
+        wire = PubsubMessage(g.BEACON_BLOCK, block).encode()
+        topic = GossipTopic(b"\x00" * 4, g.BEACON_BLOCK)
+        decoded = PubsubMessage.decode(topic, wire, harness.chain.types, "phase0")
+        assert decoded.item.message.hash_tree_root() == block.message.hash_tree_root()
+
+
+# ---------------------------------------------------------------- processor
+class TestBeaconProcessor:
+    def test_priority_order(self):
+        proc = BeaconProcessor()
+        seen = []
+        proc.register(WorkType.GOSSIP_BLOCK, lambda ev: seen.append(("block", ev.payload)))
+        proc.register(
+            WorkType.GOSSIP_ATTESTATION,
+            lambda evs: seen.append(("atts", [e.payload for e in evs])),
+        )
+        proc.send(WorkEvent(WorkType.GOSSIP_ATTESTATION, 1))
+        proc.send(WorkEvent(WorkType.GOSSIP_ATTESTATION, 2))
+        proc.send(WorkEvent(WorkType.GOSSIP_BLOCK, "b"))
+        proc.process_pending()
+        # the block outranks earlier-queued attestations
+        assert seen[0] == ("block", "b")
+        assert seen[1][0] == "atts"
+
+    def test_attestations_batched_lifo(self):
+        proc = BeaconProcessor(attestation_batch_size=3)
+        batches = []
+        proc.register(
+            WorkType.GOSSIP_ATTESTATION,
+            lambda evs: batches.append([e.payload for e in evs]),
+        )
+        for i in range(5):
+            proc.send(WorkEvent(WorkType.GOSSIP_ATTESTATION, i))
+        proc.process_pending()
+        assert [len(b) for b in batches] == [3, 2]
+        assert batches[0] == [4, 3, 2]  # LIFO: freshest first
+
+    def test_lifo_queue_evicts_oldest(self):
+        proc = BeaconProcessor()
+        q = proc.queues[WorkType.GOSSIP_ATTESTATION]
+        q.maxlen = 2
+        for i in range(3):
+            proc.send(WorkEvent(WorkType.GOSSIP_ATTESTATION, i))
+        assert [e.payload for e in q.items] == [1, 2]
+        assert q.dropped == 1
+
+    def test_fifo_queue_drops_new(self):
+        proc = BeaconProcessor()
+        q = proc.queues[WorkType.GOSSIP_BLOCK]
+        q.maxlen = 1
+        assert proc.send(WorkEvent(WorkType.GOSSIP_BLOCK, "a"))
+        assert not proc.send(WorkEvent(WorkType.GOSSIP_BLOCK, "b"))
+        assert [e.payload for e in q.items] == ["a"]
+
+
+# -------------------------------------------------------------------- peers
+class TestPeerManager:
+    def test_scores_ban(self):
+        clock = [0.0]
+        pm = PeerManager(clock=lambda: clock[0])
+        pm.connect("p1")
+        for _ in range(4):
+            pm.report_peer("p1", PeerAction.LOW_TOLERANCE_ERROR)
+        assert pm.peers["p1"].status == PeerStatus.DISCONNECTED
+        assert pm.report_peer("p1", PeerAction.FATAL) == PeerStatus.BANNED
+        assert pm.is_banned("p1")
+
+    def test_score_decays(self):
+        clock = [0.0]
+        pm = PeerManager(clock=lambda: clock[0])
+        pm.report_peer("p1", PeerAction.MID_TOLERANCE_ERROR)
+        s0 = pm.score("p1")
+        clock[0] += 600.0  # one half-life
+        assert abs(pm.score("p1") - s0 / 2) < 1e-9
+
+    def test_rate_limiter(self):
+        clock = [0.0]
+        rl = RateLimiter(clock=lambda: clock[0])
+        assert all(rl.allows("p", rpc.PING) for _ in range(2))
+        assert not rl.allows("p", rpc.PING)
+        clock[0] += 10.0  # window refill
+        assert rl.allows("p", rpc.PING)
+
+    def test_rate_limiter_block_tokens(self):
+        rl = RateLimiter(clock=lambda: 0.0)
+        assert rl.allows("p", rpc.BLOCKS_BY_RANGE, tokens=1024)
+        assert not rl.allows("p", rpc.BLOCKS_BY_RANGE, tokens=1)
+        assert not rl.allows("q", rpc.BLOCKS_BY_RANGE, tokens=2048)  # over cap
+
+
+# -------------------------------------------------------------- integration
+def _two_nodes(validator_count=16):
+    hub = InMemoryHub()
+    h1 = BeaconChainHarness(validator_count=validator_count)
+    h2 = BeaconChainHarness(validator_count=validator_count)
+    n1 = NetworkService(h1.chain, hub, "node1")
+    n2 = NetworkService(h2.chain, hub, "node2")
+    return hub, h1, h2, n1, n2
+
+
+class TestNetworkIntegration:
+    def test_block_gossip_propagates(self):
+        hub, h1, h2, n1, n2 = _two_nodes()
+        h2.slot_clock.advance_slot()
+        slot = h1.advance_slot()
+        block = h1.make_block(slot)
+        root = h1.chain.process_block(block)
+        n1.publish_block(block)
+        n2.poll()
+        assert h2.chain.head().root == root
+        assert n2.router.stats["blocks_imported"] == 1
+
+    def test_attestation_gossip_batch_verifies(self):
+        hub, h1, h2, n1, n2 = _two_nodes()
+        h2.slot_clock.advance_slot()
+        slot = h1.advance_slot()
+        block = h1.make_block(slot)
+        h1.chain.process_block(block)
+        n1.publish_block(block)
+        n2.poll()
+        # every validator attests on node1; attestations gossip to node2
+        atts = [v.attestation for v in h1.attest(slot)]
+        for att in atts:
+            n1.publish_attestation(att)
+        processed = n2.poll()
+        assert processed >= len(atts)
+        assert n2.router.stats["attestations_verified"] == len(atts)
+        assert n2.router.stats["attestations_rejected"] == 0
+
+    def test_status_triggers_range_sync(self):
+        hub, h1, h2, n1, n2 = _two_nodes()
+        h1.extend_chain(8, attest=False)
+        h2.set_slot(8)
+        # node2 handshakes node1 and discovers the longer chain
+        remote = n2.send_status("node1")
+        assert remote is not None
+        assert int(remote.head_slot) == 8
+        assert h2.chain.head().root == h1.chain.head().root
+        assert n2.sync.state == SyncState.SYNCED
+        assert n2.sync.stats["range_batches"] >= 1
+
+    def test_unknown_parent_triggers_lookup(self):
+        hub, h1, h2, n1, n2 = _two_nodes()
+        # node1 builds 3 blocks; node2 only hears the last one via gossip
+        h2.set_slot(3)
+        roots = h1.extend_chain(3, attest=False)
+        last_block = h1.chain.get_block(roots[-1])
+        n1.publish_block(last_block)
+        n2.poll()  # unknown parent → BlocksByRoot walk via hub
+        assert h2.chain.head().root == roots[-1]
+        assert n2.sync.stats["parent_lookups"] == 1
+
+    def test_banned_peer_gossip_ignored(self):
+        hub, h1, h2, n1, n2 = _two_nodes()
+        n2.peer_manager.report_peer("node1", PeerAction.FATAL)
+        slot = h1.advance_slot()
+        h2.slot_clock.advance_slot()
+        block = h1.make_block(slot)
+        h1.chain.process_block(block)
+        n1.publish_block(block)
+        n2.poll()
+        assert n2.router.stats["blocks_imported"] == 0
+
+    def test_three_node_propagation(self):
+        hub = InMemoryHub()
+        harnesses = [BeaconChainHarness(validator_count=16) for _ in range(3)]
+        services = [
+            NetworkService(h.chain, hub, f"node{i}")
+            for i, h in enumerate(harnesses)
+        ]
+        slot = harnesses[0].advance_slot()
+        for h in harnesses[1:]:
+            h.slot_clock.advance_slot()
+        block = harnesses[0].make_block(slot)
+        root = harnesses[0].chain.process_block(block)
+        services[0].publish_block(block)
+        for s in services[1:]:
+            s.poll()
+        assert all(h.chain.head().root == root for h in harnesses)
+
+    def test_voluntary_exit_gossip(self):
+        import dataclasses
+
+        from lighthouse_tpu.consensus.config import (
+            MINIMAL,
+            compute_signing_root,
+            minimal_spec,
+        )
+        from lighthouse_tpu.consensus.types import SignedVoluntaryExit, VoluntaryExit
+
+        # zero SHARD_COMMITTEE_PERIOD so validators are exitable at genesis
+        spec = dataclasses.replace(
+            minimal_spec(), preset=dataclasses.replace(MINIMAL, SHARD_COMMITTEE_PERIOD=0)
+        )
+        hub = InMemoryHub()
+        h1 = BeaconChainHarness(validator_count=16, backend="python", spec=spec)
+        h2 = BeaconChainHarness(validator_count=16, backend="python", spec=spec)
+        n1 = NetworkService(h1.chain, hub, "node1")
+        n2 = NetworkService(h2.chain, hub, "node2")
+
+        state = h1.chain.head().state
+        exit_msg = VoluntaryExit(epoch=0, validator_index=3)
+        domain = spec.get_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT, 0, state.fork,
+            h1.chain.genesis_validators_root,
+        )
+        sig = h1.keys[3].sign(compute_signing_root(exit_msg, domain))
+        signed = SignedVoluntaryExit(message=exit_msg, signature=sig.to_bytes())
+        n1.publish_voluntary_exit(signed)
+        n2.poll()
+        assert n2.router.stats["ops_accepted"] == 1
+        assert 3 in h2.chain.op_pool.voluntary_exits

@@ -1,14 +1,51 @@
 """Multi-chip (virtual 8-CPU-device mesh) tests for the sharded verifier
 and the driver entry points in __graft_entry__.py.
 
-Shapes here deliberately match dryrun_multichip(4) so the persistent
-compilation cache (conftest) shares compiles between the two tests.
+Shapes here deliberately match dryrun_multichip(4) so the in-memory jit
+cache shares compiles between the two tests.
 """
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+
+def big_stack_thread(fn):
+    """Run the test body on a freshly-allocated 512 MB-stack thread.
+
+    The shard_map pipeline's XLA compile recurses deeply. On the main
+    thread the stack must GROW to absorb it, and late in a long pytest
+    process an mmap can sit just below the stack ceiling — growth then
+    SIGSEGVs (observed: full-suite-only crashes in
+    backend_compile_and_load; isolation always passed). A pthread stack
+    is preallocated up front, so no growth, no collision."""
+    import functools
+    import threading
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        result: list = []
+        old = threading.stack_size(512 * 1024 * 1024)
+        try:
+            t = threading.Thread(
+                target=lambda: result.append(_call(fn, args, kwargs))
+            )
+            t.start()
+            t.join()
+        finally:
+            threading.stack_size(old)
+        if result and isinstance(result[0], BaseException):
+            raise result[0]
+
+    def _call(f, a, k):
+        try:
+            f(*a, **k)
+            return None
+        except BaseException as e:  # noqa: BLE001 - re-raised on the main thread
+            return e
+
+    return wrapper
 
 from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet, AggregateSignature
 from lighthouse_tpu.crypto.bls.curve import g1_infinity
@@ -36,6 +73,7 @@ def _flat_batch(sets, S, K):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+@big_stack_thread
 def test_sharded_verifier_matches_oracle():
     S, K = 4, 4
     sks = [SecretKey.from_int(i + 3) for i in range(5)]
@@ -66,6 +104,7 @@ def test_sharded_verifier_matches_oracle():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+@big_stack_thread
 def test_graft_dryrun_multichip():
     import __graft_entry__
 

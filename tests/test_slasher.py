@@ -1,0 +1,208 @@
+"""Slasher tests: double votes, surround/surrounded detection via the
+chunked min/max target arrays, double proposals, chunk persistence, and
+op-pool-ready slashing export (reference test model: slasher/tests)."""
+
+import pytest
+
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.consensus.types import (
+    BeaconBlockHeader,
+    Checkpoint,
+    SignedBeaconBlockHeader,
+    spec_types,
+)
+from lighthouse_tpu.slasher import Slasher, SlasherConfig
+from lighthouse_tpu.slasher.arrays import MAX_DISTANCE, TargetArrays
+from lighthouse_tpu.store.kv import MemoryStore
+
+SPEC = minimal_spec()
+T = spec_types(SPEC.preset)
+
+
+def _att(validators, source, target, beacon_root=b"\x01"):
+    from lighthouse_tpu.consensus.types import AttestationData
+
+    return T.IndexedAttestation(
+        attesting_indices=list(validators),
+        data=AttestationData(
+            slot=target * SPEC.preset.SLOTS_PER_EPOCH,
+            index=0,
+            beacon_block_root=beacon_root.ljust(32, b"\x00"),
+            source=Checkpoint(epoch=source, root=b"\x00" * 32),
+            target=Checkpoint(epoch=target, root=b"\x00" * 32),
+        ),
+        signature=b"\xc0" + bytes(95),
+    )
+
+
+def _header(slot, proposer, state_root=b"\x00"):
+    return SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=b"\x00" * 32,
+            state_root=state_root.ljust(32, b"\x00"),
+            body_root=b"\x00" * 32,
+        ),
+        signature=b"\xc0" + bytes(95),
+    )
+
+
+class TestTargetArrays:
+    def _arrays(self):
+        return TargetArrays(MemoryStore(), 16, 256, 4096)
+
+    def test_no_conflict_benign_sequence(self):
+        a = self._arrays()
+        for e in range(1, 10):
+            assert a.check_surround(7, e - 1, e) is None
+            a.apply(7, e - 1, e)
+
+    def test_detects_surrounding_vote(self):
+        a = self._arrays()
+        a.apply(7, 3, 4)
+        assert a.check_surround(7, 2, 5) == "surrounds"
+
+    def test_detects_surrounded_vote(self):
+        a = self._arrays()
+        a.apply(7, 2, 7)
+        assert a.check_surround(7, 3, 5) == "surrounded"
+
+    def test_same_source_not_surround(self):
+        a = self._arrays()
+        a.apply(7, 3, 5)
+        assert a.check_surround(7, 3, 7) is None  # same source: not slashable
+        assert a.check_surround(7, 3, 4) is None
+
+    def test_adjacent_targets_not_surround(self):
+        a = self._arrays()
+        a.apply(7, 2, 5)
+        assert a.check_surround(7, 1, 5) is None  # equal target = double, not surround
+
+    def test_per_validator_isolation(self):
+        a = self._arrays()
+        a.apply(7, 3, 4)
+        assert a.check_surround(8, 2, 5) is None
+
+    def test_chunk_roundtrip_through_db(self):
+        db = MemoryStore()
+        a = TargetArrays(db, 16, 256, 4096)
+        a.apply(300, 3, 4)  # validator in the second chunk
+        a.flush()
+        b = TargetArrays(db, 16, 256, 4096)
+        assert b.check_surround(300, 2, 5) == "surrounds"
+        assert b.min_targets.get(1, 0) == MAX_DISTANCE  # untouched defaults
+
+
+class TestSlasher:
+    def test_double_vote_detected(self):
+        s = Slasher(T)
+        s.accept_attestation(_att([1, 2], 0, 1, beacon_root=b"\x01"))
+        s.accept_attestation(_att([2, 3], 0, 1, beacon_root=b"\x02"))
+        found = s.process_queued(current_epoch=1)
+        assert len(found) == 1  # validator 2 only
+        f = found[0]
+        assert f.kind == "double" and f.validator_index == 2
+        slashing = s.as_attester_slashing(f)
+        # both sides decode + the conflicting data differ
+        assert slashing.attestation_1.data.hash_tree_root() != (
+            slashing.attestation_2.data.hash_tree_root()
+        )
+
+    def test_identical_attestation_not_slashable(self):
+        s = Slasher(T)
+        s.accept_attestation(_att([1], 0, 1))
+        s.accept_attestation(_att([1], 0, 1))
+        assert s.process_queued(1) == []
+
+    def test_surround_detected_across_batches(self):
+        s = Slasher(T)
+        s.accept_attestation(_att([5], 3, 4))
+        assert s.process_queued(4) == []
+        s.accept_attestation(_att([5], 2, 6))
+        found = s.process_queued(6)
+        assert len(found) == 1
+        f = found[0]
+        assert f.kind == "surrounds"
+        # attestation_1 surrounds attestation_2
+        a1, a2 = f.attestation_1.data, f.attestation_2.data
+        assert int(a1.source.epoch) < int(a2.source.epoch)
+        assert int(a2.target.epoch) < int(a1.target.epoch)
+
+    def test_surrounded_detected(self):
+        s = Slasher(T)
+        s.accept_attestation(_att([5], 1, 9))
+        s.process_queued(9)
+        s.accept_attestation(_att([5], 4, 6))
+        found = s.process_queued(9)
+        assert len(found) == 1
+        assert found[0].kind == "surrounded"
+        a1, a2 = found[0].attestation_1.data, found[0].attestation_2.data
+        assert int(a1.source.epoch) < int(a2.source.epoch)
+        assert int(a2.target.epoch) < int(a1.target.epoch)
+
+    def test_double_proposal_detected(self):
+        s = Slasher(T)
+        s.accept_block(_header(9, 4, state_root=b"\x01"))
+        s.accept_block(_header(9, 4, state_root=b"\x02"))
+        found = s.process_queued(1)
+        assert len(found) == 1
+        slashing = s.as_proposer_slashing(found[0])
+        assert int(slashing.signed_header_1.message.proposer_index) == 4
+        h1 = slashing.signed_header_1.message.hash_tree_root()
+        assert h1 != slashing.signed_header_2.message.hash_tree_root()
+
+    def test_same_block_twice_benign(self):
+        s = Slasher(T)
+        s.accept_block(_header(9, 4))
+        s.accept_block(_header(9, 4))
+        assert s.process_queued(1) == []
+
+    def test_full_block_accepted_as_header(self):
+        """Slasher accepts full SignedBeaconBlocks too (the chain feeds
+        it whatever it imports)."""
+        block = T.SIGNED_BLOCK_BY_FORK["phase0"](
+            message=T.BLOCK_BY_FORK["phase0"](slot=3, proposer_index=2)
+        )
+        other = T.SIGNED_BLOCK_BY_FORK["phase0"](
+            message=T.BLOCK_BY_FORK["phase0"](
+                slot=3, proposer_index=2, state_root=b"\x01" * 32
+            )
+        )
+        s = Slasher(T)
+        s.accept_block(block)
+        s.accept_block(other)
+        found = s.process_queued(1)
+        assert len(found) == 1
+
+    def test_slashing_feeds_op_pool(self):
+        """End-to-end: a slasher verdict becomes a block-includable
+        AttesterSlashing via the op pool (service/src/service.rs flow)."""
+        from lighthouse_tpu.chain.harness import BeaconChainHarness
+
+        h = BeaconChainHarness(validator_count=16)
+        chain = h.chain
+        s = Slasher(h.types)
+        s.accept_attestation(_att([5], 0, 2))
+        s.process_queued(2)
+        s.accept_attestation(_att([5], 1, 3))  # fork: double-ish? no — surround-free
+        s.accept_attestation(_att([5], 0, 3, beacon_root=b"\x09"))
+        found = s.process_queued(3)
+        # (0,2) vs (1,3): no surround; (1,3) vs (0,3): double at target 3
+        kinds = {f.kind for f in found}
+        assert "double" in kinds
+        f = next(f for f in found if f.kind == "double")
+        slashing = s.as_attester_slashing(f)
+        from lighthouse_tpu.consensus.verify_operation import (
+            SigVerifiedOp,
+            slashable_indices,
+        )
+
+        st = chain.head().state
+        idxs = slashable_indices(st, slashing, chain.spec)
+        assert 5 in idxs
+        chain.op_pool.insert_attester_slashing(
+            SigVerifiedOp.new(slashing, st, [0, 3])
+        )
+        _, attester = chain.op_pool.get_slashings(st)
+        assert len(attester) == 1

@@ -1,0 +1,248 @@
+"""Validator client tests: slashing protection (EIP-3076 semantics +
+interchange), EIP-2333/2334 derivation, EIP-2335 keystores, validator
+store gating, doppelganger, BN fallback, and the full duty loop against
+an in-process beacon node (reference test model:
+validator_client/src tests + slashing_protection interchange tests)."""
+
+import pytest
+
+from lighthouse_tpu.api import BeaconApi, BeaconNodeClient
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.consensus.genesis import interop_keypairs
+from lighthouse_tpu.validator import (
+    BeaconNodeFallback,
+    DoppelgangerService,
+    Keystore,
+    SlashingDatabase,
+    SlashingError,
+    ValidatorClient,
+    ValidatorStore,
+    derive_master_sk,
+    derive_validator_keys,
+)
+from lighthouse_tpu.validator.keystore import derive_child_sk
+
+
+# -------------------------------------------------------- slashing protection
+class TestSlashingProtection:
+    def setup_method(self):
+        self.db = SlashingDatabase()
+        self.pk = b"\xaa" * 48
+        self.db.register_validator(self.pk)
+
+    def test_block_monotonic(self):
+        self.db.check_and_insert_block_proposal(self.pk, 10, b"r1")
+        self.db.check_and_insert_block_proposal(self.pk, 11, b"r2")
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_block_proposal(self.pk, 11, b"other")
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_block_proposal(self.pk, 5, b"r3")
+
+    def test_block_same_root_idempotent(self):
+        self.db.check_and_insert_block_proposal(self.pk, 10, b"r1")
+        self.db.check_and_insert_block_proposal(self.pk, 10, b"r1")  # no raise
+
+    def test_attestation_double_vote(self):
+        self.db.check_and_insert_attestation(self.pk, 0, 2, b"a")
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_attestation(self.pk, 1, 2, b"b")
+
+    def test_attestation_surrounding(self):
+        self.db.check_and_insert_attestation(self.pk, 2, 3, b"a")
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_attestation(self.pk, 1, 4, b"b")
+
+    def test_attestation_surrounded(self):
+        self.db.check_and_insert_attestation(self.pk, 1, 4, b"a")
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_attestation(self.pk, 2, 3, b"b")
+
+    def test_source_after_target(self):
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_attestation(self.pk, 5, 4, b"a")
+
+    def test_unregistered_refused(self):
+        with pytest.raises(SlashingError):
+            self.db.check_and_insert_block_proposal(b"\xbb" * 48, 1, b"")
+
+    def test_interchange_roundtrip(self):
+        gvr = b"\x11" * 32
+        self.db.check_and_insert_block_proposal(self.pk, 7, b"r")
+        self.db.check_and_insert_attestation(self.pk, 0, 1, b"a")
+        exported = self.db.export_interchange(gvr)
+        assert exported["metadata"]["interchange_format_version"] == "5"
+
+        fresh = SlashingDatabase()
+        assert fresh.import_interchange(exported, gvr) == 1
+        # imported history still guards
+        with pytest.raises(SlashingError):
+            fresh.check_and_insert_block_proposal(self.pk, 7, b"other")
+        with pytest.raises(SlashingError):
+            fresh.check_and_insert_attestation(self.pk, 0, 1, b"b")
+
+    def test_interchange_wrong_root_rejected(self):
+        exported = self.db.export_interchange(b"\x11" * 32)
+        with pytest.raises(SlashingError):
+            SlashingDatabase().import_interchange(exported, b"\x22" * 32)
+
+
+# ------------------------------------------------------------------ keystores
+class TestKeyDerivation:
+    def test_eip2333_test_case_0(self):
+        """EIP-2333 published test case 0."""
+        seed = bytes.fromhex(
+            "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+            "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+        )
+        master = derive_master_sk(seed)
+        assert master == (
+            6083874454709270928345386274498605044986640685124978867557563392430687146096
+        )
+        child = derive_child_sk(master, 0)
+        assert child == (
+            20397789859736650942317412262472558107875392172444076792671091975210932703118
+        )
+
+    def test_validator_path_derivation(self):
+        seed = bytes(range(32)) * 2
+        sk0, wk0 = derive_validator_keys(seed, 0)
+        sk1, wk1 = derive_validator_keys(seed, 1)
+        assert sk0.sk != sk1.sk != wk1.sk
+        # deterministic
+        sk0b, _ = derive_validator_keys(seed, 0)
+        assert sk0.sk == sk0b.sk
+
+
+class TestKeystore:
+    def test_encrypt_decrypt_roundtrip_pbkdf2(self):
+        sk = interop_keypairs(1)[0]
+        ks = Keystore.encrypt(sk, "correct horse battery", kdf="pbkdf2",
+                              path="m/12381/3600/0/0/0")
+        restored = Keystore.from_json(ks.to_json())
+        out = restored.decrypt("correct horse battery")
+        assert out.sk == sk.sk
+        assert restored.pubkey == sk.public_key().to_bytes().hex()
+
+    def test_wrong_password_rejected(self):
+        sk = interop_keypairs(1)[0]
+        ks = Keystore.encrypt(sk, "right", kdf="pbkdf2")
+        with pytest.raises(ValueError):
+            ks.decrypt("wrong")
+
+    def test_password_control_chars_stripped(self):
+        sk = interop_keypairs(1)[0]
+        ks = Keystore.encrypt(sk, "pass\x07word", kdf="pbkdf2")
+        assert ks.decrypt("password").sk == sk.sk  # EIP-2335 normalization
+
+
+# ------------------------------------------------------------ store + gating
+class TestValidatorStore:
+    def test_sign_block_slashing_guard(self):
+        harness = BeaconChainHarness(validator_count=8)
+        store = ValidatorStore(
+            harness.spec, harness.chain.genesis_validators_root
+        )
+        sk = harness.keys[0]
+        pk = store.add_validator(sk, validator_index=0)
+        fork = harness.chain.head().state.fork
+        block = harness.types.BLOCK_BY_FORK["phase0"](slot=1, proposer_index=0)
+        sig1 = store.sign_block(pk, block, fork)
+        assert len(sig1) == 96
+        # identical block re-sign is idempotent
+        assert store.sign_block(pk, block, fork) == sig1
+        # different block, same slot = equivocation
+        other = harness.types.BLOCK_BY_FORK["phase0"](slot=1, proposer_index=0,
+                                                      state_root=b"\x01" * 32)
+        with pytest.raises(SlashingError):
+            store.sign_block(pk, other, fork)
+
+    def test_doppelganger_blocks_signing(self):
+        harness = BeaconChainHarness(validator_count=8)
+        dg = DoppelgangerService(current_epoch=0)
+        store = ValidatorStore(
+            harness.spec, harness.chain.genesis_validators_root, doppelganger=dg
+        )
+        pk = store.add_validator(harness.keys[0], validator_index=0)
+        fork = harness.chain.head().state.fork
+        with pytest.raises(SlashingError):
+            store.randao_reveal(pk, 0, fork)
+        dg.advance_epoch(2)  # detection window passed quietly
+        assert len(store.randao_reveal(pk, 0, fork)) == 96
+
+    def test_doppelganger_detection_is_permanent(self):
+        dg = DoppelgangerService(current_epoch=0)
+        dg.register(b"\xaa" * 48)
+        dg.observe_liveness(b"\xaa" * 48, 1)  # someone else attested
+        dg.advance_epoch(10)
+        assert not dg.sign_permitted(b"\xaa" * 48)
+
+
+# ------------------------------------------------------------------ fallback
+class TestFallback:
+    def test_first_success_prefers_healthy(self):
+        class Dead:
+            def node_syncing(self):
+                raise ConnectionError("down")
+
+        harness = BeaconChainHarness(validator_count=8)
+        live = BeaconNodeClient(api=BeaconApi(harness.chain))
+        fb = BeaconNodeFallback([Dead(), live])
+        ranked = fb.rank()
+        assert ranked[0] is live
+        version = fb.first_success(lambda c: c.node_version())
+        assert "lighthouse-tpu" in version["data"]["version"]
+
+    def test_all_failed_raises(self):
+        from lighthouse_tpu.validator.fallback import CandidateError
+
+        class Dead:
+            def node_syncing(self):
+                raise ConnectionError("down")
+
+            def node_version(self):
+                raise ConnectionError("down")
+
+        with pytest.raises(CandidateError):
+            BeaconNodeFallback([Dead()]).first_success(
+                lambda c: c.node_version()
+            )
+
+
+# ------------------------------------------------------------------- duty loop
+class TestValidatorClientE2E:
+    def test_full_duty_cycle(self):
+        """16 validators drive 1.5 epochs of duties through the Beacon
+        API against a harness chain; blocks get proposed and the chain
+        fills with attestations (simulator-style liveness check)."""
+        harness = BeaconChainHarness(validator_count=16)
+        chain = harness.chain
+        api = BeaconApi(chain)
+        client = BeaconNodeClient(api=api)
+        vc = ValidatorClient(
+            client, harness.spec, chain.genesis_validators_root
+        )
+        vc.add_validators(harness.keys)
+
+        p = harness.spec.preset
+        slots = p.SLOTS_PER_EPOCH + p.SLOTS_PER_EPOCH // 2
+        proposed = attested = aggregated = 0
+        for _ in range(slots):
+            slot = harness.advance_slot()
+            stats = vc.run_slot(slot)
+            proposed += stats["proposed"]
+            attested += stats["attested"]
+            aggregated += stats["aggregated"]
+
+        assert proposed == slots  # exactly one of ours proposes each slot
+        assert int(chain.head().block.message.slot) == slots
+        # each validator attests once per epoch: 16/SLOTS_PER_EPOCH per slot
+        assert attested == slots * (16 // p.SLOTS_PER_EPOCH)
+        assert aggregated >= 1
+        # attestations actually landed in blocks
+        total_in_blocks = 0
+        root = chain.head().root
+        while root != chain.genesis_block_root:
+            block = chain.get_block(root)
+            total_in_blocks += len(block.message.body.attestations)
+            root = bytes(block.message.parent_root)
+        assert total_in_blocks > 0
