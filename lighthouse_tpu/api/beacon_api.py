@@ -400,6 +400,87 @@ class BeaconApi:
         self.events.publish("exit", container_to_json(signed))
         return {}
 
+    # ------------------------------------------------------ sync committees
+    def pool_sync_committees(self, messages_json) -> dict:
+        """POST /eth/v1/beacon/pool/sync_committees."""
+        chain = self.chain
+        failures = []
+        for i, data in enumerate(messages_json):
+            msg = (
+                container_from_json(chain.types.SyncCommitteeMessage, data)
+                if isinstance(data, dict)
+                else data
+            )
+            try:
+                chain.verify_sync_committee_message_for_gossip(msg)
+            except AttestationError as e:
+                failures.append({"index": i, "message": str(e)})
+                continue
+            chain.add_to_naive_sync_pool(msg)
+            if self.network is not None:
+                # route to the member's actual subnet topic(s)
+                for subnet in chain.sync_subnets_for_validator(
+                    int(msg.validator_index)
+                ):
+                    self.network._publish_kind(f"sync_committee_{subnet}", msg)
+        if failures:
+            raise ApiError(400, f"some sync messages failed: {failures}")
+        return {}
+
+    def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                    beacon_block_root: str) -> dict:
+        root = bytes.fromhex(beacon_block_root.removeprefix("0x"))
+        contribution = self.chain.produce_sync_contribution(
+            int(slot), root, int(subcommittee_index)
+        )
+        _bad(contribution is not None, "no contribution available", 404)
+        return {"data": container_to_json(contribution)}
+
+    def publish_contribution_and_proofs(self, contributions_json) -> dict:
+        chain = self.chain
+        failures = []
+        for i, data in enumerate(contributions_json):
+            signed = (
+                container_from_json(chain.types.SignedContributionAndProof, data)
+                if isinstance(data, dict)
+                else data
+            )
+            try:
+                chain.verify_sync_contribution_for_gossip(signed)
+            except AttestationError as e:
+                failures.append({"index": i, "message": str(e)})
+                continue
+            if self.network is not None:
+                from ..network import gossip as g
+
+                self.network._publish_kind(g.SYNC_CONTRIBUTION_AND_PROOF, signed)
+        if failures:
+            raise ApiError(400, f"some contributions failed: {failures}")
+        return {}
+
+    def duties_sync(self, epoch: int, indices) -> dict:
+        """POST /eth/v1/validator/duties/sync/{epoch} — membership of the
+        sync committee for our validators (duties_service/sync.rs)."""
+        state = self._duties_state(int(epoch))
+        if state_fork_name(state) == "phase0":
+            return {"data": []}
+        want = {int(i) for i in indices}
+        members = h.current_sync_committee_indices(state, self.chain.spec)
+        duties = []
+        for vi in sorted(want):
+            positions = [p for p, m in enumerate(members) if m == vi]
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": "0x" + bytes(state.validators[vi].pubkey).hex(),
+                        "validator_index": str(vi),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+        return {"data": duties}
+
     # ----------------------------------------------------------------- /debug
     def get_debug_state(self, state_id: str) -> dict:
         """Full BeaconState JSON (eth/v2/debug/beacon/states — the

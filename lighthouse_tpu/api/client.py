@@ -187,3 +187,36 @@ class BeaconNodeClient:
             lambda: self.api.publish_aggregate_and_proofs(aggregates_json),
             body=aggregates_json,
         )
+
+    # -------------------------------------------------------- sync committee
+    def post_sync_duties(self, epoch: int, indices):
+        return self._post(
+            f"/eth/v1/validator/duties/sync/{int(epoch)}",
+            lambda: self.api.duties_sync(epoch, indices),
+            body=[str(int(i)) for i in indices],
+        )
+
+    def post_pool_sync_committees(self, messages_json):
+        return self._post(
+            "/eth/v1/beacon/pool/sync_committees",
+            lambda: self.api.pool_sync_committees(messages_json),
+            body=messages_json,
+        )
+
+    def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                    beacon_block_root: str):
+        return self._get(
+            f"/eth/v1/validator/sync_committee_contribution?slot={int(slot)}"
+            f"&subcommittee_index={int(subcommittee_index)}"
+            f"&beacon_block_root={beacon_block_root}",
+            lambda: self.api.sync_committee_contribution(
+                slot, subcommittee_index, beacon_block_root
+            ),
+        )
+
+    def post_contribution_and_proofs(self, contributions_json):
+        return self._post(
+            "/eth/v1/validator/contribution_and_proofs",
+            lambda: self.api.publish_contribution_and_proofs(contributions_json),
+            body=contributions_json,
+        )

@@ -42,6 +42,10 @@ route("POST", r"/eth/v1/beacon/blocks", "publish_block")
 route("POST", r"/eth/v1/beacon/pool/attestations", "pool_attestations")
 route("GET", r"/eth/v1/beacon/pool/attestations", "get_pool_attestations")
 route("POST", r"/eth/v1/beacon/pool/voluntary_exits", "pool_voluntary_exit")
+route("POST", r"/eth/v1/beacon/pool/sync_committees", "pool_sync_committees")
+route("GET", r"/eth/v1/validator/sync_committee_contribution", "sync_committee_contribution")
+route("POST", r"/eth/v1/validator/contribution_and_proofs", "publish_contribution_and_proofs")
+route("POST", r"/eth/v1/validator/duties/sync/(?P<epoch>\d+)", "duties_sync", ("epoch",))
 route("GET", r"/eth/v2/debug/beacon/states/(?P<state_id>[^/]+)", "get_debug_state", ("state_id",))
 route("GET", r"/eth/v1/node/version", "node_version")
 route("GET", r"/eth/v1/node/syncing", "node_syncing")
@@ -65,7 +69,9 @@ BODY_AS_PAYLOAD = {
     "publish_block",
     "pool_attestations",
     "pool_voluntary_exit",
+    "pool_sync_committees",
     "publish_aggregate_and_proofs",
+    "publish_contribution_and_proofs",
     "subscribe_beacon_committee",
 }
 # query params forwarded as keyword arguments (ints where sensible)
@@ -77,8 +83,12 @@ QUERY_KWARGS = {
     "produce_block": ("randao_reveal", "graffiti"),
     "attestation_data": ("slot", "committee_index"),
     "aggregate_attestation": ("slot", "attestation_data_root"),
+    "sync_committee_contribution": (
+        "slot", "subcommittee_index", "beacon_block_root",
+    ),
 }
-INT_QUERY_PARAMS = {"epoch", "index", "slot", "committee_index"}
+INT_QUERY_PARAMS = {"epoch", "index", "slot", "committee_index",
+                    "subcommittee_index"}
 
 
 class HttpServer:
@@ -129,9 +139,9 @@ class HttpServer:
                 args = []
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                if name in BODY_AS_PAYLOAD or name == "duties_attester":
+                if name in BODY_AS_PAYLOAD or name in ("duties_attester", "duties_sync"):
                     payload = json.loads(body) if body else None
-                    if name == "duties_attester":
+                    if name in ("duties_attester", "duties_sync"):
                         kwargs["indices"] = [int(x) for x in (payload or [])]
                     else:
                         args.append(payload)

@@ -43,6 +43,7 @@ from ..store.hot_cold import HotColdDB
 from .caches import (
     BeaconProposerCache,
     NaiveAggregationPool,
+    NaiveSyncAggregationPool,
     ObservedAggregates,
     ObservedAttesters,
     ObservedBlockProducers,
@@ -91,6 +92,14 @@ class BeaconChain:
         self.types = spec_types(spec.preset)
         # optional ExecutionLayer handle (reference: beacon_chain.execution_layer)
         self.execution_layer = None
+        from .validator_monitor import ValidatorMonitor
+
+        self.validator_monitor = ValidatorMonitor()
+        from ..consensus.cached_tree_hash import StateRootCache
+
+        # incremental merkleization for the per-block state-root check
+        # (reference: the state's tree_hash_cache)
+        self.state_root_cache = StateRootCache()
 
         self.genesis_block_root = genesis_block_root
         self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
@@ -106,6 +115,16 @@ class BeaconChain:
         self.observed_aggregates = ObservedAggregates()
         self.observed_block_producers = ObservedBlockProducers()
         self.naive_aggregation_pool = NaiveAggregationPool()
+        from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+        self.naive_sync_pool = NaiveSyncAggregationPool(
+            spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        # (slot, …) keyed observation sets, pruned per slot
+        self.observed_sync_contributions: set = set()
+        self.observed_sync_contributors: set = set()
+        # sync-committee membership, cached per sync-committee period
+        self._sync_members_cache: tuple[int, list[int]] | None = None
 
         self.fork_choice = ForkChoice.from_anchor(
             genesis_state,
@@ -228,9 +247,13 @@ class BeaconChain:
                 self.fork_choice.on_attestation(
                     max(ops_slot, int(block.slot)), indexed, is_from_block=True
                 )
+                self.validator_monitor.observe_block_attestation_indices(
+                    att, indexed.attesting_indices, int(block.slot)
+                )
             except (ValueError, ForkChoiceError):
                 continue
 
+        self.validator_monitor.observe_block(block, block_root, self.spec)
         self.recompute_head()
         return block_root
 
@@ -288,6 +311,7 @@ class BeaconChain:
             self.observed_attesters.prune(finalized_epoch)
             self.observed_aggregates.prune(finalized_epoch)
             self.observed_block_producers.prune(finalized_epoch * p.SLOTS_PER_EPOCH)
+            self.validator_monitor.prune(finalized_epoch)
             self.fork_choice.prune()
             self.op_pool.prune(self._head.state)
             # migrate finalized history into the freezer
@@ -366,7 +390,7 @@ class BeaconChain:
             get_pubkey=self.pubkey_cache.as_getter(),
             caches=caches,
         )
-        block.state_root = state.hash_tree_root()
+        block.state_root = self.state_root_cache.state_root(state)
         return block, state
 
     def _produce_execution_payload(self, state, slot: int):
@@ -601,6 +625,9 @@ class BeaconChain:
         self.fork_choice.on_attestation(
             self.current_slot(), verified.indexed, is_from_block=False
         )
+        self.validator_monitor.observe_gossip_attestation(
+            verified.indexed, self.current_slot(), self.spec
+        )
 
     def add_to_naive_aggregation_pool(self, verified: "VerifiedAttestation"):
         self.naive_aggregation_pool.insert(verified.attestation)
@@ -608,11 +635,160 @@ class BeaconChain:
     def add_to_operation_pool(self, verified: "VerifiedAttestation"):
         self.op_pool.insert_attestation(verified.attestation)
 
+    # ====================================== sync committee verification
+    def _sync_committee_members(self, state) -> list[int]:
+        """Cached current-sync-committee validator indices: the
+        committee is stable for EPOCHS_PER_SYNC_COMMITTEE_PERIOD, so
+        resolve the O(registry) pubkey mapping once per period."""
+        p = self.spec.preset
+        period = h.get_current_epoch(state, self.spec) // (
+            p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        cached = self._sync_members_cache
+        if cached is not None and cached[0] == period:
+            return cached[1]
+        members = h.current_sync_committee_indices(state, self.spec)
+        self._sync_members_cache = (period, members)
+        return members
+
+    def verify_sync_committee_message_for_gossip(self, message):
+        """(reference: sync_committee_verification.rs
+        verify_sync_committee_message_for_gossip)"""
+        state = self._head.state
+        if state_fork_name(state) == "phase0":
+            raise AttestationError("sync committees require altair")
+        slot = int(message.slot)
+        current = self.current_slot()
+        if not (current - 1 <= slot <= current + FUTURE_SLOT_TOLERANCE):
+            raise AttestationError("sync message outside the current slot window")
+        vi = int(message.validator_index)
+        members = self._sync_committee_members(state)
+        if vi not in members:
+            raise AttestationError("validator not in the current sync committee")
+        key = (slot, vi)
+        if key in self.observed_sync_contributors:
+            raise AttestationError("duplicate sync message for slot")
+        sig_set = sigs.sync_committee_message_set(
+            state, self.pubkey_cache.as_getter(), message, self.spec
+        )
+        if not verify_signature_sets([sig_set], backend=self.backend):
+            raise AttestationError("invalid sync message signature")
+        self.observed_sync_contributors.add(key)
+        return message
+
+    def sync_subnets_for_validator(self, validator_index: int) -> set[int]:
+        """Subnets this committee member's positions map onto (the
+        gossip topic routing for its messages)."""
+        from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+        members = self._sync_committee_members(self._head.state)
+        size = self.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        return {
+            position // size
+            for position, member in enumerate(members)
+            if member == int(validator_index)
+        }
+
+    def add_to_naive_sync_pool(self, message) -> None:
+        from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+        state = self._head.state
+        members = self._sync_committee_members(state)
+        size = self.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        vi = int(message.validator_index)
+        for position, member in enumerate(members):
+            if member == vi:
+                self.naive_sync_pool.insert(
+                    int(message.slot),
+                    bytes(message.beacon_block_root),
+                    position // size,
+                    position % size,
+                    bytes(message.signature),
+                )
+
+    def produce_sync_contribution(self, slot: int, block_root: bytes,
+                                  subcommittee_index: int):
+        entry = self.naive_sync_pool.get(slot, block_root, subcommittee_index)
+        if entry is None:
+            return None
+        bits, sig = entry
+        return self.types.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=bytes(block_root),
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=list(bits),
+            signature=sig.to_bytes(),
+        )
+
+    def verify_sync_contribution_for_gossip(self, signed_contribution):
+        """Three sets: selection proof, aggregator signature, contribution
+        aggregate (reference: sync_committee_verification.rs:618 batch)."""
+        message = signed_contribution.message
+        contribution = message.contribution
+        state = self._head.state
+        if state_fork_name(state) == "phase0":
+            raise AttestationError("sync committees require altair")
+        slot = int(contribution.slot)
+        current = self.current_slot()
+        if not (current - 1 <= slot <= current + FUTURE_SLOT_TOLERANCE):
+            raise AttestationError("contribution outside the slot window")
+        # the aggregator must itself sit in the target subcommittee
+        # (reference: AggregatorNotInCommittee)
+        if int(contribution.subcommittee_index) not in (
+            self.sync_subnets_for_validator(int(message.aggregator_index))
+        ):
+            raise AttestationError("aggregator not in the subcommittee")
+        if not h.is_sync_committee_aggregator(
+            bytes(message.selection_proof), self.spec
+        ):
+            raise AttestationError("invalid sync aggregator selection")
+        key = (slot, int(contribution.subcommittee_index),
+               contribution.hash_tree_root())
+        if key in self.observed_sync_contributions:
+            raise AttestationError("contribution already known")
+        from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+
+        all_members = self._sync_committee_members(state)
+        size = self.spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        start = int(contribution.subcommittee_index) * size
+        members = all_members[start : start + size]
+        participants = [
+            m for m, bit in zip(members, contribution.aggregation_bits) if bit
+        ]
+        get_pubkey = self.pubkey_cache.as_getter()
+        sets = [
+            sigs.sync_committee_selection_proof_signature_set(
+                state, get_pubkey, message, self.spec
+            ),
+            sigs.signed_contribution_and_proof_signature_set(
+                state, get_pubkey, signed_contribution, self.spec
+            ),
+        ]
+        contrib_set = sigs.sync_committee_contribution_signature_set(
+            state, get_pubkey, contribution, participants, self.spec
+        )
+        if contrib_set is not None:
+            sets.append(contrib_set)
+        if not verify_signature_sets(sets, backend=self.backend):
+            raise AttestationError("invalid sync contribution signature(s)")
+        self.observed_sync_contributions.add(key)
+        self.op_pool.insert_sync_contribution(contribution)
+        return signed_contribution
+
     # ------------------------------------------------------------ slot tasks
     def per_slot_task(self) -> None:
         """(reference: beacon_chain.rs per_slot_task via timer)"""
         slot = self.current_slot()
         self.naive_aggregation_pool.prune(slot)
+        self.naive_sync_pool.prune(slot)
+        # sync observation sets are (slot, …)-keyed; retain a short window
+        cutoff = slot - 3
+        self.observed_sync_contributors = {
+            k for k in self.observed_sync_contributors if k[0] >= cutoff
+        }
+        self.observed_sync_contributions = {
+            k for k in self.observed_sync_contributions if k[0] >= cutoff
+        }
         self.fork_choice.update_time(slot)
 
 
@@ -706,7 +882,7 @@ class ExecutionPendingBlock:
         except BlockProcessingError as e:
             raise BlockError(f"state transition failed: {e}") from e
 
-        computed_root = state.hash_tree_root()
+        computed_root = chain.state_root_cache.state_root(state)
         if computed_root != bytes(block.state_root):
             raise BlockError("state root mismatch")
 

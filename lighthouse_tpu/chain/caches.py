@@ -164,6 +164,42 @@ class ObservedBlockProducers:
             del self._seen[s]
 
 
+class NaiveSyncAggregationPool:
+    """Aggregate sync-committee messages per (slot, block_root,
+    subcommittee) until aggregators collect them (reference:
+    naive_aggregation_pool.rs SyncContributionAggregateMap)."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self, subcommittee_size: int):
+        self.subcommittee_size = subcommittee_size
+        # (slot, root, subcommittee) -> (bits, AggregateSignature)
+        self._map: dict[tuple, tuple] = {}
+
+    def insert(self, slot: int, block_root: bytes, subcommittee: int,
+               position: int, signature: bytes) -> None:
+        key = (slot, bytes(block_root), subcommittee)
+        sig = AggregateSignature.from_bytes(bytes(signature))
+        entry = self._map.get(key)
+        if entry is None:
+            bits = [False] * self.subcommittee_size
+            bits[position] = True
+            self._map[key] = (bits, sig)
+            return
+        bits, agg = entry
+        if bits[position]:
+            return  # already contributed
+        bits[position] = True
+        agg.add_assign_aggregate(sig)
+
+    def get(self, slot: int, block_root: bytes, subcommittee: int):
+        return self._map.get((slot, bytes(block_root), subcommittee))
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = current_slot - self.SLOTS_RETAINED
+        self._map = {k: v for k, v in self._map.items() if k[0] >= cutoff}
+
+
 class NaiveAggregationPool:
     """Aggregate unaggregated attestations per data root until the slot's
     aggregators collect them (reference: naive_aggregation_pool.rs)."""

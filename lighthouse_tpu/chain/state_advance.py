@@ -1,0 +1,56 @@
+"""State-advance timer (reference: beacon_chain/src/state_advance_timer.rs:89).
+
+Three-quarters through each slot the node pre-computes the head state
+advanced to the NEXT slot and plants it in the snapshot cache, so block
+production at the next slot start and attestation verification against
+next-slot shufflings skip the epoch/slot processing latency. The
+reference guards against re-advancing (lock) and only advances within
+one slot of the head; both carried over.
+"""
+
+from __future__ import annotations
+
+from ..common.metrics import REGISTRY
+from ..consensus.transition.advance import complete_state_advance
+
+
+class StateAdvanceTimer:
+    def __init__(self, chain):
+        self.chain = chain
+        self._advanced_for: bytes | None = None  # head root last advanced
+        self._m = REGISTRY.counter(
+            "state_advance_runs_total", "Pre-emptive state advances", ("outcome",)
+        )
+
+    def due(self) -> bool:
+        """True in the last quarter of the current slot."""
+        frac = self.chain.slot_clock.seconds_from_current_slot_start()
+        if frac is None:
+            return False
+        return frac >= 0.75 * self.chain.slot_clock.seconds_per_slot
+
+    def run(self) -> bool:
+        """Advance head state to next slot into the snapshot cache
+        (state_advance_timer.rs advance_head)."""
+        chain = self.chain
+        head = chain.head()
+        if self._advanced_for == head.root:
+            self._m.inc(outcome="already_advanced")
+            return False
+        next_slot = chain.current_slot() + 1
+        if int(head.state.slot) >= next_slot:
+            self._m.inc(outcome="head_ahead")
+            return False
+        try:
+            # COMPLETE advance (real state roots): the snapshot cache
+            # feeds block import, which must see exact roots
+            advanced = complete_state_advance(
+                head.state.copy(), None, next_slot, chain.spec
+            )
+        except Exception:
+            self._m.inc(outcome="error")
+            return False
+        chain.snapshot_cache.insert(head.root, advanced)
+        self._advanced_for = head.root
+        self._m.inc(outcome="success")
+        return True

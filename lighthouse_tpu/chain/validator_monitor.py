@@ -1,0 +1,124 @@
+"""ValidatorMonitor — per-validator liveness/performance introspection
+(reference: beacon_chain/src/validator_monitor.rs, 1.5k LoC).
+
+Operators register validator indices (or auto-register all); the chain
+feeds every imported block and verified attestation through the
+monitor, which tracks per-validator per-epoch: blocks proposed,
+attestations seen (gossip vs in-block), inclusion delay, hit/miss
+summaries — surfaced as metrics and on-demand reports (the reference
+additionally logs per-event; here the structured logger hook is
+optional).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..common.metrics import REGISTRY
+
+
+@dataclass
+class EpochSummary:
+    """(validator_monitor.rs EpochSummary)"""
+
+    attestations_seen: int = 0
+    attestation_min_delay_slots: int | None = None
+    attestations_in_block: int = 0
+    min_inclusion_delay: int | None = None
+    blocks_proposed: int = 0
+    sync_messages_seen: int = 0
+
+
+class ValidatorMonitor:
+    def __init__(self, auto_register: bool = False, log=None):
+        self.auto_register = auto_register
+        self.log = log
+        self._watched: set[int] = set()
+        # validator -> epoch -> summary
+        self.summaries: dict[int, dict[int, EpochSummary]] = defaultdict(
+            lambda: defaultdict(EpochSummary)
+        )
+        self._m_atts = REGISTRY.counter(
+            "validator_monitor_attestations_total",
+            "Attestations observed for watched validators", ("src",),
+        )
+        self._m_blocks = REGISTRY.counter(
+            "validator_monitor_blocks_total",
+            "Blocks proposed by watched validators",
+        )
+
+    # ---------------------------------------------------------- registration
+    def register_validator(self, index: int) -> None:
+        self._watched.add(int(index))
+
+    def watched(self, index: int) -> bool:
+        return self.auto_register or int(index) in self._watched
+
+    # ------------------------------------------------------------ ingestion
+    def observe_gossip_attestation(self, indexed, seen_slot: int, spec) -> None:
+        epoch = int(indexed.data.target.epoch)
+        delay = max(0, seen_slot - int(indexed.data.slot))
+        for vi in indexed.attesting_indices:
+            vi = int(vi)
+            if not self.watched(vi):
+                continue
+            s = self.summaries[vi][epoch]
+            s.attestations_seen += 1
+            if (
+                s.attestation_min_delay_slots is None
+                or delay < s.attestation_min_delay_slots
+            ):
+                s.attestation_min_delay_slots = delay
+            self._m_atts.inc(src="gossip")
+            if self.log is not None:
+                self.log.debug(
+                    "attestation seen", validator=vi, epoch=epoch, delay=delay
+                )
+
+    def observe_block(self, block, block_root: bytes, spec) -> None:
+        proposer = int(block.proposer_index)
+        p = spec.preset
+        if self.watched(proposer):
+            epoch = int(block.slot) // p.SLOTS_PER_EPOCH
+            self.summaries[proposer][epoch].blocks_proposed += 1
+            self._m_blocks.inc()
+            if self.log is not None:
+                self.log.info(
+                    "block proposed", validator=proposer, slot=int(block.slot)
+                )
+
+    def observe_block_attestation_indices(self, att, indices, block_slot: int):
+        """Explicit per-attestation accounting when the chain has the
+        committee handy (import_block calls this)."""
+        epoch = int(att.data.target.epoch)
+        delay = block_slot - int(att.data.slot)
+        for vi in indices:
+            vi = int(vi)
+            if not self.watched(vi):
+                continue
+            s = self.summaries[vi][epoch]
+            s.attestations_in_block += 1
+            if s.min_inclusion_delay is None or delay < s.min_inclusion_delay:
+                s.min_inclusion_delay = delay
+            self._m_atts.inc(src="block")
+
+    def observe_sync_committee_message(self, message) -> None:
+        vi = int(message.validator_index)
+        if not self.watched(vi):
+            return
+        epoch_guess = int(message.slot)  # stored per-slot under sync key
+        self.summaries[vi][epoch_guess].sync_messages_seen += 1
+
+    # --------------------------------------------------------------- reports
+    def epoch_report(self, epoch: int) -> dict[int, EpochSummary]:
+        out = {}
+        for vi, epochs in self.summaries.items():
+            if epoch in epochs:
+                out[vi] = epochs[epoch]
+        return out
+
+    def prune(self, finalized_epoch: int) -> None:
+        for vi in list(self.summaries):
+            for e in [e for e in self.summaries[vi] if e < finalized_epoch]:
+                del self.summaries[vi][e]

@@ -50,6 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--http", action="store_true")
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--metrics", action="store_true")
+    bn.add_argument("--metrics-port", type=int, default=0)
+    bn.add_argument("--validator-monitor-auto", action="store_true")
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--interop-validators", type=int, default=64)
     bn.add_argument("--checkpoint-sync-url", default=None)
@@ -120,6 +122,8 @@ def run_bn(args) -> int:
         http_enabled=args.http,
         http_port=args.http_port,
         metrics_enabled=args.metrics,
+        metrics_port=args.metrics_port,
+        validator_monitor_auto=args.validator_monitor_auto,
         slasher_enabled=args.slasher,
         backend=args.backend,
         manual_clock=args.slots > 0,
@@ -232,11 +236,15 @@ def run_lcli(args) -> int:
         from .consensus.genesis import interop_genesis_state, interop_keypairs
         from .crypto.bls import backends as bls_backends
 
+        prev = bls_backends._default
         bls_backends.set_default_backend("fake")
-        state = interop_genesis_state(
-            interop_keypairs(args.validator_count), args.genesis_time, spec,
-            sign_deposits=False,
-        )
+        try:
+            state = interop_genesis_state(
+                interop_keypairs(args.validator_count), args.genesis_time, spec,
+                sign_deposits=False,
+            )
+        finally:
+            bls_backends._default = prev
         print(json.dumps({
             "genesis_validators_root": "0x"
             + bytes(state.genesis_validators_root).hex(),

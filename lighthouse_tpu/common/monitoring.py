@@ -1,0 +1,69 @@
+"""Remote monitoring poster (reference: common/monitoring_api, 574 LoC
+— periodically POSTs beaconnode/validator process metrics JSON to a
+remote endpoint in the beaconcha.in client-stats format)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+
+class MonitoringService:
+    def __init__(self, endpoint: str, node=None, vc=None, timeout: float = 5.0):
+        self.endpoint = endpoint
+        self.node = node
+        self.vc = vc
+        self.timeout = timeout
+        self.posts = 0
+
+    def collect(self) -> list[dict]:
+        """client-stats JSON bodies (monitoring_api/src/types.rs)."""
+        now = int(time.time() * 1000)
+        out = []
+        if self.node is not None:
+            chain = self.node.chain
+            head = chain.head()
+            out.append(
+                {
+                    "version": 1,
+                    "timestamp": now,
+                    "process": "beaconnode",
+                    "sync_beacon_head_slot": int(head.block.message.slot),
+                    "sync_eth2_synced": (
+                        chain.current_slot()
+                        - int(head.block.message.slot)
+                    ) <= 1,
+                    "slasher_active": self.node.slasher is not None,
+                    "network_peers_connected": (
+                        len(self.node.network.peer_manager.connected_peers())
+                        if self.node.network
+                        else 0
+                    ),
+                }
+            )
+        if self.vc is not None:
+            out.append(
+                {
+                    "version": 1,
+                    "timestamp": now,
+                    "process": "validator",
+                    "validator_total": len(self.vc.store.voting_pubkeys()),
+                    "validator_active": len(self.vc.store.voting_pubkeys()),
+                }
+            )
+        return out
+
+    def post(self) -> bool:
+        body = json.dumps(self.collect()).encode()
+        req = urllib.request.Request(
+            self.endpoint,
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                self.posts += 1
+                return True
+        except OSError:
+            return False

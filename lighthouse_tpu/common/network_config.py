@@ -1,0 +1,85 @@
+"""Embedded network configs (reference: common/eth2_network_config —
+built-in config.yaml + boot nodes + genesis per network, baked in via
+include_bytes and melted into ChainSpec).
+
+Networks here carry the YAML-equivalent dicts inline (no genesis.ssz
+blobs: interop/checkpoint genesis cover this framework's boot paths)
+and apply themselves onto a ChainSpec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..consensus.config import ChainSpec, mainnet_spec, minimal_spec
+
+BUILT_IN: dict[str, dict] = {
+    "mainnet": {
+        "PRESET_BASE": "mainnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 16384,
+        "MIN_GENESIS_TIME": 1606824000,
+        "GENESIS_DELAY": 604800,
+        "GENESIS_FORK_VERSION": "0x00000000",
+        "ALTAIR_FORK_VERSION": "0x01000000",
+        "ALTAIR_FORK_EPOCH": 74240,
+        "BELLATRIX_FORK_VERSION": "0x02000000",
+        "BELLATRIX_FORK_EPOCH": 144896,
+        "SECONDS_PER_SLOT": 12,
+        "ETH1_FOLLOW_DISTANCE": 2048,
+        "DEPOSIT_CHAIN_ID": 1,
+        "boot_enr": [],
+    },
+    "prater": {
+        "PRESET_BASE": "mainnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 16384,
+        "MIN_GENESIS_TIME": 1614588812,
+        "GENESIS_FORK_VERSION": "0x00001020",
+        "ALTAIR_FORK_VERSION": "0x01001020",
+        "ALTAIR_FORK_EPOCH": 36660,
+        "BELLATRIX_FORK_VERSION": "0x02001020",
+        "BELLATRIX_FORK_EPOCH": 112260,
+        "SECONDS_PER_SLOT": 12,
+        "ETH1_FOLLOW_DISTANCE": 2048,
+        "DEPOSIT_CHAIN_ID": 5,
+        "boot_enr": [],
+    },
+    "minimal-interop": {
+        "PRESET_BASE": "minimal",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 64,
+        "GENESIS_FORK_VERSION": "0x00000001",
+        "SECONDS_PER_SLOT": 6,
+        "ETH1_FOLLOW_DISTANCE": 16,
+        "boot_enr": [],
+    },
+}
+
+
+def _ver(v: str) -> bytes:
+    return bytes.fromhex(v.removeprefix("0x"))
+
+
+def spec_for_network(name: str) -> ChainSpec:
+    """Melt a built-in network config into a ChainSpec
+    (eth2_network_config/src/lib.rs apply_to_chain_spec)."""
+    cfg = BUILT_IN.get(name)
+    if cfg is None:
+        raise KeyError(f"unknown network {name!r}; have {sorted(BUILT_IN)}")
+    base = minimal_spec() if cfg["PRESET_BASE"] == "minimal" else mainnet_spec()
+    updates: dict = {"name": name}
+    for key in (
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT", "MIN_GENESIS_TIME",
+        "GENESIS_DELAY", "SECONDS_PER_SLOT", "ETH1_FOLLOW_DISTANCE",
+        "ALTAIR_FORK_EPOCH", "BELLATRIX_FORK_EPOCH",
+    ):
+        if key in cfg and hasattr(base, key):
+            updates[key] = cfg[key]
+    for key in (
+        "GENESIS_FORK_VERSION", "ALTAIR_FORK_VERSION", "BELLATRIX_FORK_VERSION",
+    ):
+        if key in cfg and hasattr(base, key):
+            updates[key] = _ver(cfg[key])
+    return dataclasses.replace(base, **updates)
+
+
+def boot_nodes(name: str) -> list[str]:
+    return list(BUILT_IN.get(name, {}).get("boot_enr", []))

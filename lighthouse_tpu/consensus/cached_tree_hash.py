@@ -1,0 +1,140 @@
+"""Incremental merkleization (reference: consensus/cached_tree_hash —
+TreeHashCache + per-field arenas making per-slot state re-hash O(dirty
+leaves) instead of O(n)).
+
+``TreeHashCache`` maintains the full merkle layer structure over a
+list's leaf chunks; ``update`` diffs the new leaves against the cached
+ones and recomputes only the paths above changed leaves.
+``StateRootCache`` applies it to a BeaconState's big lists (validators,
+balances, inactivity_scores) — the dominant hashing cost at scale — and
+defers every other field to the plain hasher.
+"""
+
+from __future__ import annotations
+
+from .hashing import hash_bytes
+from . import ssz
+
+
+def _hash2(a: bytes, b: bytes) -> bytes:
+    return hash_bytes(a + b)
+
+
+_ZERO = [b"\x00" * 32]
+while len(_ZERO) < 48:
+    _ZERO.append(_hash2(_ZERO[-1], _ZERO[-1]))
+
+
+class TreeHashCache:
+    """Merkle layers over leaf chunks with subtree-limit semantics
+    (matches ssz.merkleize_chunks(leaves, limit))."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.depth = max(0, (limit - 1).bit_length()) if limit > 1 else 0
+        self.leaves: list[bytes] = []
+        # layers[0] = leaves, layers[d] = top
+        self.layers: list[list[bytes]] = [[] for _ in range(self.depth + 1)]
+
+    # ------------------------------------------------------------ structure
+    def _parent_recompute(self, layer: int, index: int) -> None:
+        below = self.layers[layer - 1]
+        left = below[2 * index] if 2 * index < len(below) else _ZERO[layer - 1]
+        right = (
+            below[2 * index + 1] if 2 * index + 1 < len(below) else _ZERO[layer - 1]
+        )
+        row = self.layers[layer]
+        node = _hash2(left, right)
+        if index < len(row):
+            row[index] = node
+        else:
+            while len(row) < index:
+                row.append(_ZERO[layer])
+            row.append(node)
+
+    def update(self, new_leaves: list[bytes]) -> bytes:
+        """Diff + recompute; returns the (limit-padded) merkle root
+        WITHOUT length mix-in."""
+        if len(new_leaves) > self.limit:
+            raise ssz.SszError("leaf count exceeds limit")
+        dirty: set[int] = set()
+        old = self.leaves
+        for i, leaf in enumerate(new_leaves):
+            if i >= len(old) or old[i] != leaf:
+                dirty.add(i)
+        if len(new_leaves) < len(old):
+            dirty.update(range(len(new_leaves), len(old)))
+            # shrinkage: truncated leaves become zero-subtrees
+        self.leaves = list(new_leaves)
+        self.layers[0] = self.leaves
+        for layer in range(1, self.depth + 1):
+            parents = {i // 2 for i in dirty}
+            for p in sorted(parents):
+                self._parent_recompute(layer, p)
+            # trim rows above shrunken leaves
+            expected = (len(new_leaves) + (1 << layer) - 1) >> layer
+            if len(self.layers[layer]) > max(expected, 1):
+                del self.layers[layer][max(expected, 1):]
+            dirty = parents
+        return self.root()
+
+    def root(self) -> bytes:
+        top = self.layers[self.depth]
+        return top[0] if top else _ZERO[self.depth]
+
+
+class ListRootCache:
+    """hash_tree_root of List(elem, limit) via TreeHashCache: element
+    roots (or packed basic chunks) as leaves + length mix-in."""
+
+    def __init__(self, schema: ssz.List):
+        self.schema = schema
+        elem = schema.elem
+        if isinstance(elem, (ssz.Uint, ssz.Boolean)):
+            per_chunk = 32 // elem.fixed_len
+            limit_chunks = (schema.limit + per_chunk - 1) // per_chunk
+            self.packed = True
+        else:
+            limit_chunks = schema.limit
+            self.packed = False
+        self.cache = TreeHashCache(limit_chunks)
+        self._elem_roots: list[bytes] = []  # element-root memo for diffing
+
+    def root(self, values: list) -> bytes:
+        elem = self.schema.elem
+        if self.packed:
+            packed = b"".join(elem.encode(v) for v in values)
+            leaves = ssz.pack_bytes(packed) if packed else []
+        else:
+            leaves = [elem.hash_tree_root(v) for v in values]
+        return ssz.mix_in_length(self.cache.update(leaves), len(values))
+
+
+class StateRootCache:
+    """Cache the heavy list fields of a BeaconState (beacon_state
+    tree_hash_cache.rs role). Correctness contract: output equals the
+    plain ``state.hash_tree_root()`` for any state of this preset.
+    Thread-safe: callers share one cache across HTTP/gossip threads
+    (the reference guards its tree hash cache the same way)."""
+
+    HEAVY_FIELDS = ("validators", "balances", "inactivity_scores")
+
+    def __init__(self):
+        import threading
+
+        self._list_caches: dict[str, ListRootCache] = {}
+        self._lock = threading.Lock()
+
+    def state_root(self, state) -> bytes:
+        with self._lock:
+            chunks = []
+            for name, schema in state.fields.items():
+                if name in self.HEAVY_FIELDS and isinstance(schema, ssz.List):
+                    cache = self._list_caches.get(name)
+                    if cache is None or cache.schema is not schema:
+                        cache = ListRootCache(schema)
+                        self._list_caches[name] = cache
+                    chunks.append(cache.root(getattr(state, name)))
+                else:
+                    chunks.append(schema.hash_tree_root(getattr(state, name)))
+            return ssz.merkleize_chunks(chunks)

@@ -237,6 +237,45 @@ def is_aggregator(committee_length: int, selection_proof: bytes,
     return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+
+
+def current_sync_committee_indices(state, spec: ChainSpec) -> list[int]:
+    """Validator indices of the state's current sync committee, in
+    committee order (altair; duplicates possible for tiny registries)."""
+    by_pubkey: dict[bytes, int] = {
+        bytes(v.pubkey): i for i, v in enumerate(state.validators)
+    }
+    return [
+        by_pubkey[bytes(pk)] for pk in state.current_sync_committee.pubkeys
+    ]
+
+
+def sync_subcommittee_members(state, subcommittee_index: int,
+                              spec: ChainSpec) -> list[int]:
+    """Validator indices of one sync subcommittee slice."""
+    from .config import SYNC_COMMITTEE_SUBNET_COUNT
+
+    size = spec.preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    members = current_sync_committee_indices(state, spec)
+    start = subcommittee_index * size
+    return members[start : start + size]
+
+
+def is_sync_committee_aggregator(selection_proof: bytes,
+                                 spec: ChainSpec) -> bool:
+    """Spec (altair) is_sync_committee_aggregator."""
+    from .config import SYNC_COMMITTEE_SUBNET_COUNT
+
+    modulo = max(
+        1,
+        spec.preset.SYNC_COMMITTEE_SIZE
+        // SYNC_COMMITTEE_SUBNET_COUNT
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    return int.from_bytes(hash_bytes(selection_proof)[:8], "little") % modulo == 0
+
+
 def get_attesting_indices(
     state, data, aggregation_bits, spec: ChainSpec, cache=None
 ) -> list[int]:

@@ -262,3 +262,66 @@ def sync_committee_message_set(
         [_pk(get_pubkey, message.validator_index)],
         signing_root_of_root(bytes(message.beacon_block_root), domain),
     )
+
+
+def sync_committee_contribution_signature_set(
+    state, get_pubkey: GetPubkey, contribution, participant_indices,
+    spec: ChainSpec,
+) -> SignatureSet | None:
+    """(:507) A subcommittee contribution: aggregate of the set
+    participants over the block root."""
+    epoch = compute_epoch_at_slot(int(contribution.slot), spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_SYNC_COMMITTEE, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    sig = _sig(contribution.signature)
+    pubkeys = [_pk(get_pubkey, i) for i in participant_indices]
+    if not pubkeys and sig.is_infinity():
+        return None
+    return SignatureSet.multiple_pubkeys(
+        sig, pubkeys,
+        signing_root_of_root(bytes(contribution.beacon_block_root), domain),
+    )
+
+
+def sync_committee_selection_proof_signature_set(
+    state, get_pubkey: GetPubkey, contribution_and_proof, spec: ChainSpec
+) -> SignatureSet:
+    """(:472) The aggregator's selection proof over
+    SyncAggregatorSelectionData{slot, subcommittee_index}."""
+    from .types import SyncAggregatorSelectionData
+
+    contribution = contribution_and_proof.contribution
+    slot = int(contribution.slot)
+    epoch = compute_epoch_at_slot(slot, spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    selection_data = SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=int(contribution.subcommittee_index)
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(contribution_and_proof.selection_proof),
+        [_pk(get_pubkey, int(contribution_and_proof.aggregator_index))],
+        compute_signing_root(selection_data, domain),
+    )
+
+
+def signed_contribution_and_proof_signature_set(
+    state, get_pubkey: GetPubkey, signed_contribution, spec: ChainSpec
+) -> SignatureSet:
+    """(:563) The aggregator's outer signature over
+    ContributionAndProof."""
+    message = signed_contribution.message
+    epoch = compute_epoch_at_slot(int(message.contribution.slot), spec)
+    domain = spec.get_domain(
+        spec.DOMAIN_CONTRIBUTION_AND_PROOF, epoch, state.fork,
+        state.genesis_validators_root,
+    )
+    return SignatureSet.multiple_pubkeys(
+        _sig(signed_contribution.signature),
+        [_pk(get_pubkey, int(message.aggregator_index))],
+        compute_signing_root(message, domain),
+    )

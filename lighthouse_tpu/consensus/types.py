@@ -141,6 +141,12 @@ class VoluntaryExit(Container):
     fields = {"epoch": uint64, "validator_index": uint64}
 
 
+class SyncAggregatorSelectionData(Container):
+    """Signed by sync aggregators to prove selection (spec altair)."""
+
+    fields = {"slot": uint64, "subcommittee_index": uint64}
+
+
 class SignedVoluntaryExit(Container):
     fields = {"message": VoluntaryExit.schema, "signature": Bytes96}
 

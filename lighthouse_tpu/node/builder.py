@@ -39,7 +39,10 @@ class ClientConfig:
     http_enabled: bool = False
     http_port: int = 0
     metrics_enabled: bool = False
+    metrics_port: int = 0  # 0 = ephemeral (tests); set for scrape targets
     slasher_enabled: bool = False
+    validator_monitor_auto: bool = False  # watch all validators
+    validator_monitor_indices: tuple = ()  # or specific indices
     attestation_batch_size: int = 1024
     manual_clock: bool = True           # deterministic by default
     extra: dict = field(default_factory=dict)
@@ -49,15 +52,20 @@ class BeaconNode:
     def __init__(self, chain: BeaconChain, network: NetworkService | None,
                  api: BeaconApi, http: HttpServer | None,
                  slasher: Slasher | None, executor: TaskExecutor,
-                 log: StructuredLogger, spec: ChainSpec):
+                 log: StructuredLogger, spec: ChainSpec,
+                 metrics_server=None):
+        from ..chain.state_advance import StateAdvanceTimer
+
         self.chain = chain
         self.network = network
         self.api = api
         self.http = http
+        self.metrics_server = metrics_server
         self.slasher = slasher
         self.executor = executor
         self.log = log
         self.spec = spec
+        self.state_advance = StateAdvanceTimer(chain)
         self._slot_metric = REGISTRY.gauge("beacon_head_slot", "Head slot")
 
     # ------------------------------------------------------------ lifecycle
@@ -124,7 +132,15 @@ class BeaconNode:
     def start(self) -> "BeaconNode":
         """Spawn the timed loops for wall-clock operation."""
         seconds = self.spec.SECONDS_PER_SLOT
+
+        def maybe_advance():
+            if self.state_advance.due():
+                self.state_advance.run()
+
         self.executor.spawn_periodic(self.tick_slot, seconds, "slot_timer")
+        self.executor.spawn_periodic(
+            maybe_advance, seconds / 8, "state_advance_timer"
+        )
         if self.network is not None:
             self.executor.spawn_periodic(self.network.poll, 0.05, "network_poll")
         return self
@@ -133,6 +149,8 @@ class BeaconNode:
         self.executor.shutdown.trigger("node stopped")
         if self.http is not None:
             self.http.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
 
 
 class ClientBuilder:
@@ -238,10 +256,20 @@ class ClientBuilder:
         http = None
         if cfg.http_enabled:
             http = HttpServer(api, port=cfg.http_port).start()
+        metrics_server = None
+        if cfg.metrics_enabled:
+            from ..api.http_metrics import MetricsServer
+
+            metrics_server = MetricsServer(port=cfg.metrics_port).start()
+            self.log.info("metrics server listening", url=metrics_server.url)
+        chain.validator_monitor.auto_register = cfg.validator_monitor_auto
+        for index in cfg.validator_monitor_indices:
+            chain.validator_monitor.register_validator(int(index))
 
         executor = TaskExecutor(self._node_id)
         node = BeaconNode(
-            chain, network, api, http, slasher, executor, self.log, self.spec
+            chain, network, api, http, slasher, executor, self.log, self.spec,
+            metrics_server=metrics_server,
         )
         if slasher is not None and network is not None:
             # feed gossip attestations and blocks into the slasher

@@ -1,0 +1,118 @@
+"""Historic state reconstruction (reference: store/src/reconstruct.rs).
+
+A checkpoint-synced node holds backfilled *blocks* down to genesis but
+no historic *states*. Reconstruction replays those blocks forward from
+the genesis (anchor) state, writing the freezer's chunked root vectors
+and periodic restore-point states, after which every historic
+state-at-slot query resolves exactly as on an archive node.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..consensus.transition.replay import BlockReplayer
+from .hot_cold import (
+    COL_COLD_BLOCK_ROOTS,
+    COL_COLD_STATE_ROOTS,
+    COL_RESTORE_POINT,
+    _enc_u64,
+)
+
+
+def reconstruct_historic_states(store, genesis_state, *, upto_slot: int | None = None,
+                                block_root_at=None) -> int:
+    """Replay backfilled blocks from genesis to the split (or
+    ``upto_slot``), persisting freezer columns. ``block_root_at(slot)``
+    resolves the canonical root per slot (defaults to the freezer's own
+    chunked vectors — present when backfill stored them — else walks
+    parent links from the split anchor). Returns slots reconstructed."""
+    spec = store.spec
+    p = spec.preset
+    target = upto_slot if upto_slot is not None else store.split.slot
+    if target <= 0:
+        return 0
+
+    # resolve the canonical block roots [1, target] by walking parents
+    # from the anchor block down (backfill guarantees linkage)
+    roots_by_slot: dict[int, bytes] = {}
+    if block_root_at is None:
+        # walk from the highest known block backwards
+        root = _highest_block_root(store, target)
+        while root is not None:
+            block = store.get_block(root)
+            if block is None:
+                break
+            slot = int(block.message.slot)
+            if slot > target:
+                root = bytes(block.message.parent_root)
+                continue
+            roots_by_slot[slot] = root
+            if slot == 0:
+                break
+            root = bytes(block.message.parent_root)
+    else:
+        for slot in range(1, target + 1):
+            r = block_root_at(slot)
+            if r is not None:
+                roots_by_slot[slot] = r
+
+    srp = store.config.slots_per_restore_point
+    chunks: dict[tuple[bytes, int], bytearray] = {}
+
+    def set_root(column: bytes, slot: int, root: bytes):
+        ck = (column, slot // store.config.chunk_size)
+        if ck not in chunks:
+            existing = store.db.get(column, _enc_u64(ck[1]))
+            buf = bytearray(existing or b"\x00" * (32 * store.config.chunk_size))
+            chunks[ck] = buf
+        i = (slot % store.config.chunk_size) * 32
+        chunks[ck][i : i + 32] = root
+
+    state = genesis_state.copy()
+    ops = []
+    genesis_root = store.genesis_block_root()
+    last_block_root = genesis_root if genesis_root is not None else b"\x00" * 32
+    reconstructed = 0
+    for slot in range(0, target):
+        if slot > 0:
+            block_root = roots_by_slot.get(slot)
+            if block_root is not None:
+                block = store.get_block(block_root)
+                replayer = (
+                    BlockReplayer(state, spec).no_signature_verification()
+                )
+                state = replayer.apply_blocks([block], target_slot=slot).into_state()
+                last_block_root = block_root
+            else:
+                # skipped slot: advance only
+                from ..consensus.transition.slot import process_slots
+
+                state = process_slots(state, slot, spec)
+        set_root(COL_COLD_BLOCK_ROOTS, slot, last_block_root)
+        set_root(COL_COLD_STATE_ROOTS, slot, state.hash_tree_root())
+        if slot % srp == 0:
+            ops.append(
+                ("put", COL_RESTORE_POINT, _enc_u64(slot // srp),
+                 store._encode_state(state))
+            )
+        reconstructed += 1
+
+    for (column, chunk_index), buf in chunks.items():
+        ops.append(("put", column, _enc_u64(chunk_index), bytes(buf)))
+    store.db.batch(ops)
+    return reconstructed
+
+
+def _highest_block_root(store, target: int) -> bytes | None:
+    """Best-effort: the block at/nearest-below ``target`` (the split
+    anchor block stored by checkpoint sync / backfill)."""
+    from .hot_cold import COL_BLOCK
+
+    best_root, best_slot = None, -1
+    for key, raw in store.db.iter_column(COL_BLOCK):
+        block = store._decode_block(raw)
+        slot = int(block.message.slot)
+        if best_slot < slot <= target:
+            best_root, best_slot = key, slot
+    return best_root

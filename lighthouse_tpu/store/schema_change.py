@@ -1,0 +1,55 @@
+"""On-disk schema migrations (reference: store/src/metadata.rs
+CURRENT_SCHEMA_VERSION + beacon_chain/src/schema_change.rs +
+database_manager's migrate command).
+
+Each migration is a pure function (db, from_version) -> None registered
+in MIGRATIONS; ``migrate_schema`` walks them up (or refuses to walk
+down, like the reference) and stamps the new version. V1 is the genesis
+schema, so the table starts empty — the machinery exists so future
+layout changes ship with data migrations instead of resyncs.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .hot_cold import COL_META, CURRENT_SCHEMA_VERSION, KEY_SCHEMA, StoreError, _enc_u64
+
+# (from_version, to_version) -> fn(db) — applied in sequence
+MIGRATIONS: dict[tuple[int, int], callable] = {}
+
+
+def register_migration(from_version: int, to_version: int):
+    def deco(fn):
+        MIGRATIONS[(from_version, to_version)] = fn
+        return fn
+
+    return deco
+
+
+def read_schema_version(db) -> int:
+    raw = db.get(COL_META, KEY_SCHEMA)
+    return struct.unpack(">Q", raw)[0] if raw is not None else 0
+
+
+def migrate_schema(db, target: int = CURRENT_SCHEMA_VERSION) -> int:
+    """Apply registered migrations to reach ``target``; returns the
+    final version. Downgrades are refused (schema_change.rs)."""
+    current = read_schema_version(db)
+    if current == 0:
+        db.put(COL_META, KEY_SCHEMA, _enc_u64(target))
+        return target
+    if current > target:
+        raise StoreError(
+            f"refusing downgrade from schema v{current} to v{target}"
+        )
+    while current < target:
+        step = MIGRATIONS.get((current, current + 1))
+        if step is None:
+            raise StoreError(
+                f"no migration path from schema v{current} to v{current + 1}"
+            )
+        step(db)
+        current += 1
+        db.put(COL_META, KEY_SCHEMA, _enc_u64(current))
+    return current

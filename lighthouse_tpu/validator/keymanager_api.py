@@ -1,0 +1,168 @@
+"""Validator-client keymanager HTTP API (reference: the VC's own warp
+http_api — the eth2 keymanager spec surface: list/import/delete
+keystores, plus fee-recipient and health probes).
+
+Runs on the VC process, guarded by a bearer token (the reference writes
+an api-token.txt; here the token is supplied or generated)."""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .keystore import Keystore
+
+
+class KeymanagerApi:
+    """Transport-agnostic handlers over a ValidatorClient."""
+
+    def __init__(self, vc, token: str | None = None):
+        self.vc = vc
+        self.token = token or secrets.token_hex(16)
+        self.fee_recipients: dict[bytes, str] = {}
+
+    # ------------------------------------------------------------- keystores
+    def list_keystores(self) -> dict:
+        return {
+            "data": [
+                {
+                    "validating_pubkey": "0x" + pk.hex(),
+                    "derivation_path": "",
+                    "readonly": False,
+                }
+                for pk in self.vc.store.voting_pubkeys()
+            ]
+        }
+
+    def import_keystores(self, keystores_json, passwords,
+                         slashing_protection=None) -> dict:
+        statuses = []
+        if slashing_protection:
+            self.vc.store.slashing_db.import_interchange(
+                slashing_protection, self.vc.store.genesis_validators_root
+            )
+        for raw, password in zip(keystores_json, passwords):
+            try:
+                ks = Keystore.from_json(raw)
+                sk = ks.decrypt(password)
+                self.vc.store.add_validator(sk)
+                statuses.append({"status": "imported"})
+            except Exception as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return {"data": statuses}
+
+    def delete_keystores(self, pubkeys) -> dict:
+        statuses = []
+        gvr = self.vc.store.genesis_validators_root
+        for pk_hex in pubkeys:
+            pk = bytes.fromhex(pk_hex.removeprefix("0x"))
+            if pk in self.vc.store._signers:
+                del self.vc.store._signers[pk]
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        interchange = self.vc.store.slashing_db.export_interchange(gvr)
+        return {
+            "data": statuses,
+            "slashing_protection": json.dumps(interchange),
+        }
+
+    # --------------------------------------------------------- fee recipient
+    def get_fee_recipient(self, pubkey_hex: str) -> dict:
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        return {
+            "data": {
+                "pubkey": pubkey_hex,
+                "ethaddress": self.fee_recipients.get(pk, "0x" + "00" * 20),
+            }
+        }
+
+    def set_fee_recipient(self, pubkey_hex: str, ethaddress: str) -> dict:
+        pk = bytes.fromhex(pubkey_hex.removeprefix("0x"))
+        self.fee_recipients[pk] = ethaddress
+        return {}
+
+
+class KeymanagerServer:
+    """The HTTP adapter with bearer-token auth."""
+
+    def __init__(self, api: KeymanagerApi, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        api_ref = api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _authed(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {api_ref.token}"
+
+            def _respond(self, status, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if not self._authed():
+                    return self._respond(401, {"message": "unauthorized"})
+                if self.path == "/eth/v1/keystores":
+                    return self._respond(200, api_ref.list_keystores())
+                if self.path.startswith("/eth/v1/validator/") and self.path.endswith("/feerecipient"):
+                    pubkey = self.path.split("/")[4]
+                    return self._respond(200, api_ref.get_fee_recipient(pubkey))
+                self._respond(404, {"message": "not found"})
+
+            def do_POST(self):
+                if not self._authed():
+                    return self._respond(401, {"message": "unauthorized"})
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else {}
+                if self.path == "/eth/v1/keystores":
+                    return self._respond(
+                        200,
+                        api_ref.import_keystores(
+                            body.get("keystores", []),
+                            body.get("passwords", []),
+                            body.get("slashing_protection"),
+                        ),
+                    )
+                if self.path.startswith("/eth/v1/validator/") and self.path.endswith("/feerecipient"):
+                    pubkey = self.path.split("/")[4]
+                    return self._respond(
+                        200,
+                        api_ref.set_fee_recipient(pubkey, body.get("ethaddress", "")),
+                    )
+                self._respond(404, {"message": "not found"})
+
+            def do_DELETE(self):
+                if not self._authed():
+                    return self._respond(401, {"message": "unauthorized"})
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else {}
+                if self.path == "/eth/v1/keystores":
+                    return self._respond(
+                        200, api_ref.delete_keystores(body.get("pubkeys", []))
+                    )
+                self._respond(404, {"message": "not found"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "KeymanagerServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -178,6 +178,8 @@ class ValidatorClient:
 
     def __init__(self, client, spec, genesis_validators_root: bytes,
                  slashing_db=None, doppelganger=None):
+        from .sync_committee import SyncCommitteeService
+
         self.spec = spec
         self.client = client
         self.store = ValidatorStore(
@@ -186,6 +188,9 @@ class ValidatorClient:
         self.duties = DutiesService(client, self.store, spec)
         self.block_service = BlockService(client, self.store, self.duties, spec)
         self.attestation_service = AttestationService(
+            client, self.store, self.duties, spec
+        )
+        self.sync_committee_service = SyncCommitteeService(
             client, self.store, self.duties, spec
         )
         self._last_polled_epoch: int | None = None
@@ -200,14 +205,19 @@ class ValidatorClient:
         epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
         if self._last_polled_epoch != epoch:
             self.duties.poll(epoch)
+            self.sync_committee_service.poll(epoch)
             self._last_polled_epoch = epoch
             if self.store.doppelganger is not None:
                 self.store.doppelganger.advance_epoch(epoch)
         proposed = self.block_service.propose(slot)
         attested = self.attestation_service.attest(slot)
+        sync_messages = self.sync_committee_service.produce_messages(slot)
         aggregated = self.attestation_service.aggregate(slot)
+        contributions = self.sync_committee_service.produce_contributions(slot)
         return {
             "proposed": len(proposed),
             "attested": attested,
             "aggregated": aggregated,
+            "sync_messages": sync_messages,
+            "sync_contributions": contributions,
         }

@@ -111,6 +111,36 @@ class ValidatorStore:
         root = compute_signing_root(message, domain)
         return self._raw_sign(pubkey, root)
 
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int,
+                                    block_root: bytes, fork) -> bytes:
+        p = self.spec.preset
+        epoch = slot // p.SLOTS_PER_EPOCH
+        domain = self._domain(self.spec.DOMAIN_SYNC_COMMITTEE, epoch, fork)
+        root = merkleize_chunks([bytes(block_root), domain])
+        return self._raw_sign(pubkey, root)
+
+    def sign_sync_selection_proof(self, pubkey: bytes, slot: int,
+                                  subcommittee_index: int, fork) -> bytes:
+        from ..consensus.types import SyncAggregatorSelectionData
+
+        p = self.spec.preset
+        epoch = slot // p.SLOTS_PER_EPOCH
+        domain = self._domain(
+            self.spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch, fork
+        )
+        data = SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        return self._raw_sign(pubkey, compute_signing_root(data, domain))
+
+    def sign_contribution_and_proof(self, pubkey: bytes, message, fork) -> bytes:
+        p = self.spec.preset
+        epoch = int(message.contribution.slot) // p.SLOTS_PER_EPOCH
+        domain = self._domain(
+            self.spec.DOMAIN_CONTRIBUTION_AND_PROOF, epoch, fork
+        )
+        return self._raw_sign(pubkey, compute_signing_root(message, domain))
+
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg, fork) -> bytes:
         domain = self._domain(
             self.spec.DOMAIN_VOLUNTARY_EXIT, int(exit_msg.epoch), fork

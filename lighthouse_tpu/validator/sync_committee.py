@@ -1,0 +1,158 @@
+"""SyncCommitteeService (reference: validator_client/src/
+sync_committee_service.rs + duties_service/sync.rs).
+
+At 1/3 through each slot every member of the current sync committee
+signs the head block root and publishes a SyncCommitteeMessage; at 2/3
+the elected aggregators fetch per-subcommittee contributions and
+publish SignedContributionAndProofs. Duties (committee membership and
+per-slot selection proofs) come from POST duties/sync/{epoch}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api.beacon_api import ApiError
+from ..api.json_codec import container_from_json, container_to_json
+from ..consensus.config import SYNC_COMMITTEE_SUBNET_COUNT
+from ..consensus.helpers import is_sync_committee_aggregator
+from ..consensus.types import spec_types
+
+
+@dataclass
+class SyncDuty:
+    pubkey: bytes
+    validator_index: int
+    positions: list[int] = field(default_factory=list)  # committee slots
+
+
+class SyncCommitteeService:
+    def __init__(self, client, store, duties_service, spec):
+        self.client = client
+        self.store = store
+        self.duties_service = duties_service
+        self.spec = spec
+        self.types = spec_types(spec.preset)
+        self._duties: dict[int, list[SyncDuty]] = {}  # epoch -> duties
+        self.messages_published = 0
+        self.contributions_published = 0
+
+    def _call(self, op):
+        if hasattr(self.client, "first_success"):
+            return self.client.first_success(op)
+        return op(self.client)
+
+    # ---------------------------------------------------------------- duties
+    def poll(self, epoch: int) -> None:
+        indices = [
+            self.store.index_of(pk)
+            for pk in self.store.voting_pubkeys()
+            if self.store.index_of(pk) is not None
+        ]
+        if not indices:
+            self._duties[epoch] = []
+            return
+        resp = self._call(lambda c: c.post_sync_duties(epoch, indices))
+        duties = []
+        for d in resp.get("data", []):
+            duties.append(
+                SyncDuty(
+                    pubkey=bytes.fromhex(d["pubkey"].removeprefix("0x")),
+                    validator_index=int(d["validator_index"]),
+                    positions=[
+                        int(p) for p in d["validator_sync_committee_indices"]
+                    ],
+                )
+            )
+        self._duties[epoch] = duties
+        for e in [e for e in self._duties if e < epoch - 1]:
+            del self._duties[e]
+
+    def duties_at(self, epoch: int) -> list[SyncDuty]:
+        return self._duties.get(epoch, [])
+
+    # -------------------------------------------------------------- produce
+    def produce_messages(self, slot: int) -> int:
+        """Phase 1 (slot+1/3): every member signs the head root."""
+        p = self.spec.preset
+        epoch = slot // p.SLOTS_PER_EPOCH
+        duties = self.duties_at(epoch)
+        if not duties:
+            return 0
+        fork = self.duties_service._fork()
+        head_root = self._call(lambda c: c.get_block_root("head"))["data"]["root"]
+        root_bytes = bytes.fromhex(head_root.removeprefix("0x"))
+        out = []
+        for duty in duties:
+            signature = self.store.sign_sync_committee_message(
+                duty.pubkey, slot, root_bytes, fork
+            )
+            out.append(
+                self.types.SyncCommitteeMessage(
+                    slot=slot,
+                    beacon_block_root=root_bytes,
+                    validator_index=duty.validator_index,
+                    signature=signature,
+                )
+            )
+        if out:
+            self._call(
+                lambda c: c.post_pool_sync_committees(
+                    [container_to_json(m) for m in out]
+                )
+            )
+            self.messages_published += len(out)
+        return len(out)
+
+    def produce_contributions(self, slot: int) -> int:
+        """Phase 2 (slot+2/3): aggregators publish contributions."""
+        p = self.spec.preset
+        epoch = slot // p.SLOTS_PER_EPOCH
+        duties = self.duties_at(epoch)
+        if not duties:
+            return 0
+        fork = self.duties_service._fork()
+        head_root = self._call(lambda c: c.get_block_root("head"))["data"]["root"]
+        sub_size = p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        published = 0
+        for duty in duties:
+            subcommittees = {pos // sub_size for pos in duty.positions}
+            for sub in subcommittees:
+                proof = self.store.sign_sync_selection_proof(
+                    duty.pubkey, slot, sub, fork
+                )
+                if not is_sync_committee_aggregator(proof, self.spec):
+                    continue
+                try:
+                    data = self._call(
+                        lambda c: c.sync_committee_contribution(
+                            slot, sub, head_root
+                        )
+                    )["data"]
+                except ApiError:
+                    continue
+                contribution = container_from_json(
+                    self.types.SyncCommitteeContribution, data
+                )
+                message = self.types.ContributionAndProof(
+                    aggregator_index=duty.validator_index,
+                    contribution=contribution,
+                    selection_proof=proof,
+                )
+                signature = self.store.sign_contribution_and_proof(
+                    duty.pubkey, message, fork
+                )
+                signed = self.types.SignedContributionAndProof(
+                    message=message, signature=signature
+                )
+                try:
+                    self._call(
+                        lambda c: c.post_contribution_and_proofs(
+                            [container_to_json(signed)]
+                        )
+                    )
+                    published += 1
+                except ApiError:
+                    continue
+        self.contributions_published += published
+        return published

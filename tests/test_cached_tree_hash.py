@@ -1,0 +1,63 @@
+"""Incremental merkleization tests (reference: cached_tree_hash tests —
+cache output must be bit-exact with the plain hasher through arbitrary
+mutations)."""
+
+import random
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.consensus import ssz
+from lighthouse_tpu.consensus.cached_tree_hash import (
+    ListRootCache,
+    StateRootCache,
+    TreeHashCache,
+)
+
+
+class TestTreeHashCache:
+    def test_matches_plain_merkleize(self):
+        rng = random.Random(1)
+        cache = TreeHashCache(limit=64)
+        leaves: list[bytes] = []
+        for step in range(30):
+            op = rng.randrange(3)
+            if op == 0 or not leaves:
+                leaves.append(bytes([rng.randrange(256)] * 32))
+            elif op == 1:
+                leaves[rng.randrange(len(leaves))] = bytes(
+                    [rng.randrange(256)] * 32
+                )
+            else:
+                leaves.pop()
+            got = cache.update(list(leaves))
+            want = ssz.merkleize_chunks(list(leaves), limit=64)
+            assert got == want, f"step {step}: {got.hex()} != {want.hex()}"
+
+    def test_empty(self):
+        cache = TreeHashCache(limit=16)
+        assert cache.update([]) == ssz.merkleize_chunks([], limit=16)
+
+
+class TestListRootCache:
+    def test_uint_list_matches_schema(self):
+        schema = ssz.List(ssz.uint64, 1024)
+        cache = ListRootCache(schema)
+        values = list(range(100))
+        assert cache.root(values) == schema.hash_tree_root(values)
+        values[7] = 999_999
+        values.append(12345)
+        assert cache.root(values) == schema.hash_tree_root(values)
+
+
+class TestStateRootCache:
+    def test_state_root_exact_through_chain_growth(self):
+        h = BeaconChainHarness(validator_count=16)
+        cache = StateRootCache()
+        state = h.chain.head().state
+        assert cache.state_root(state) == state.hash_tree_root()
+        h.extend_chain(3)
+        state = h.chain.head().state
+        assert cache.state_root(state) == state.hash_tree_root()
+        # mutate a heavy field and re-verify
+        state = state.copy()
+        state.balances[3] = int(state.balances[3]) + 1
+        assert cache.state_root(state) == state.hash_tree_root()

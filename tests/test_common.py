@@ -109,3 +109,64 @@ class TestLogging:
 
     def test_null_logger_silent(self):
         NullLogger().crit("nothing")  # no exception, no output
+
+
+class TestNetworkConfig:
+    def test_builtin_networks(self):
+        from lighthouse_tpu.common.network_config import spec_for_network
+
+        spec = spec_for_network("mainnet")
+        assert spec.preset.name == "mainnet"
+        assert spec.ALTAIR_FORK_EPOCH == 74240
+        assert spec.ALTAIR_FORK_VERSION == b"\x01\x00\x00\x00"
+        interop = spec_for_network("minimal-interop")
+        assert interop.preset.name == "minimal"
+        assert interop.GENESIS_FORK_VERSION == b"\x00\x00\x00\x01"
+
+    def test_unknown_network(self):
+        import pytest as _pytest
+
+        from lighthouse_tpu.common.network_config import spec_for_network
+
+        with _pytest.raises(KeyError):
+            spec_for_network("nope")
+
+
+class TestMonitoring:
+    def test_collect_and_post(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import threading
+
+        from lighthouse_tpu.common.monitoring import MonitoringService
+        from lighthouse_tpu.consensus.config import minimal_spec
+        from lighthouse_tpu.node import ClientBuilder, ClientConfig
+
+        received = []
+
+        class Sink(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                received.append(_json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        node = (
+            ClientBuilder(ClientConfig(validator_count=8), minimal_spec())
+            .memory_store().interop_genesis().build()
+        )
+        try:
+            svc = MonitoringService(
+                f"http://127.0.0.1:{httpd.server_address[1]}/", node=node
+            )
+            assert svc.post()
+            assert received[0][0]["process"] == "beaconnode"
+            assert received[0][0]["sync_eth2_synced"] is True
+        finally:
+            node.stop()
+            httpd.shutdown()

@@ -1,0 +1,172 @@
+"""Auxiliary subsystem tests: validator monitor, state-advance timer,
+metrics scrape server, EIP-2386 wallet, and the VC keymanager API
+(reference: validator_monitor.rs, state_advance_timer.rs, http_metrics,
+eth2_wallet, the VC http_api)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.api.http_metrics import MetricsServer
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.chain.state_advance import StateAdvanceTimer
+from lighthouse_tpu.common.metrics import Registry
+from lighthouse_tpu.validator.keymanager_api import KeymanagerApi, KeymanagerServer
+from lighthouse_tpu.validator.wallet import Wallet
+
+
+class TestValidatorMonitor:
+    def test_tracks_proposals_and_attestations(self):
+        h = BeaconChainHarness(validator_count=16)
+        monitor = h.chain.validator_monitor
+        monitor.auto_register = True
+        h.extend_chain(4)
+        # every slot had a proposal; proposers are watched
+        proposals = sum(
+            s.blocks_proposed
+            for epochs in monitor.summaries.values()
+            for s in epochs.values()
+        )
+        assert proposals == 4
+        gossip_seen = sum(
+            s.attestations_seen
+            for epochs in monitor.summaries.values()
+            for s in epochs.values()
+        )
+        assert gossip_seen > 0
+        in_block = sum(
+            s.attestations_in_block
+            for epochs in monitor.summaries.values()
+            for s in epochs.values()
+        )
+        assert in_block > 0
+
+    def test_unwatched_ignored(self):
+        h = BeaconChainHarness(validator_count=16)
+        monitor = h.chain.validator_monitor
+        monitor.register_validator(3)  # only 3 watched
+        h.extend_chain(4)
+        assert set(monitor.summaries) <= {3}
+
+
+class TestStateAdvance:
+    def test_preadvances_next_slot(self):
+        h = BeaconChainHarness(validator_count=16)
+        h.extend_chain(1)
+        timer = StateAdvanceTimer(h.chain)
+        head = h.chain.head()
+        assert timer.run()
+        snap = h.chain.snapshot_cache.get_cloned(head.root)
+        assert int(snap.slot) == h.chain.current_slot() + 1
+        assert not timer.run()  # idempotent per head
+
+    def test_due_window(self):
+        h = BeaconChainHarness(validator_count=16)
+        timer = StateAdvanceTimer(h.chain)
+        h.slot_clock.set_slot(1)
+        assert not timer.due()  # slot start
+        h.slot_clock.advance_time(0.8 * h.spec.SECONDS_PER_SLOT)
+        assert timer.due()
+
+
+class TestMetricsServer:
+    def test_scrape(self):
+        reg = Registry()
+        reg.counter("test_requests", "R").inc(3)
+        srv = MetricsServer(registry=reg).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics") as resp:
+                text = resp.read().decode()
+            assert "test_requests 3.0" in text
+            with urllib.request.urlopen(srv.url + "/health") as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+
+class TestWallet:
+    def test_create_roundtrip_and_accounts(self):
+        seed = bytes(range(64))
+        w = Wallet.create("w1", "wpass", seed=seed, kdf="pbkdf2")
+        restored = Wallet.from_json(w.to_json())
+        assert restored.decrypt_seed("wpass") == seed
+        ks0 = restored.next_validator("wpass", "kpass")
+        ks1 = restored.next_validator("wpass", "kpass")
+        assert restored.nextaccount == 2
+        sk0 = ks0.decrypt("kpass")
+        sk1 = ks1.decrypt("kpass")
+        assert sk0.sk != sk1.sk
+        # deterministic: same wallet seed → same keys
+        from lighthouse_tpu.validator.keystore import derive_validator_keys
+
+        expect0, _ = derive_validator_keys(seed, 0)
+        assert sk0.sk == expect0.sk
+
+    def test_wrong_password(self):
+        w = Wallet.create("w1", "right", seed=bytes(64), kdf="pbkdf2")
+        with pytest.raises(ValueError):
+            w.decrypt_seed("wrong")
+
+
+class TestKeymanagerApi:
+    def _vc(self):
+        from lighthouse_tpu.api import BeaconApi, BeaconNodeClient
+        from lighthouse_tpu.validator import ValidatorClient
+
+        h = BeaconChainHarness(validator_count=8)
+        client = BeaconNodeClient(api=BeaconApi(h.chain))
+        vc = ValidatorClient(client, h.spec, h.chain.genesis_validators_root)
+        return h, vc
+
+    def test_import_list_delete_over_http(self):
+        from lighthouse_tpu.validator.keystore import Keystore
+
+        h, vc = self._vc()
+        api = KeymanagerApi(vc, token="secret")
+        srv = KeymanagerServer(api).start()
+        try:
+            ks = Keystore.encrypt(h.keys[0], "pw", kdf="pbkdf2")
+
+            def call(method, path, body=None, token="secret"):
+                req = urllib.request.Request(
+                    srv.url + path,
+                    method=method,
+                    data=json.dumps(body).encode() if body else None,
+                    headers={"Authorization": f"Bearer {token}",
+                             "Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req) as resp:
+                    return json.loads(resp.read())
+
+            out = call("POST", "/eth/v1/keystores",
+                       {"keystores": [ks.to_json()], "passwords": ["pw"]})
+            assert out["data"][0]["status"] == "imported"
+            listed = call("GET", "/eth/v1/keystores")["data"]
+            assert len(listed) == 1
+            pk = listed[0]["validating_pubkey"]
+            out = call("DELETE", "/eth/v1/keystores", {"pubkeys": [pk]})
+            assert out["data"][0]["status"] == "deleted"
+            assert "slashing_protection" in out
+            assert call("GET", "/eth/v1/keystores")["data"] == []
+        finally:
+            srv.stop()
+
+    def test_auth_required(self):
+        h, vc = self._vc()
+        srv = KeymanagerServer(KeymanagerApi(vc, token="secret")).start()
+        try:
+            req = urllib.request.Request(srv.url + "/eth/v1/keystores")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+        finally:
+            srv.stop()
+
+    def test_fee_recipient(self):
+        h, vc = self._vc()
+        api = KeymanagerApi(vc)
+        pk = "0x" + h.keys[0].public_key().to_bytes().hex()
+        api.set_fee_recipient(pk, "0x" + "ab" * 20)
+        out = api.get_fee_recipient(pk)["data"]
+        assert out["ethaddress"] == "0x" + "ab" * 20
