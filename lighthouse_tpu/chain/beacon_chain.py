@@ -775,6 +775,21 @@ class BeaconChain:
         self.op_pool.insert_sync_contribution(contribution)
         return signed_contribution
 
+    # ------------------------------------------------------------ persistence
+    def persist(self) -> None:
+        """Write head/fork-choice/op-pool to the store so a restart
+        resumes exactly here (persist_head/persist_fork_choice)."""
+        from .persistence import save_chain
+
+        save_chain(self)
+
+    @classmethod
+    def from_store(cls, store, spec, slot_clock, backend=None) -> "BeaconChain":
+        """Resume from a persisted store (ClientGenesis::FromStore)."""
+        from .persistence import load_chain
+
+        return load_chain(store, spec, slot_clock, backend=backend)
+
     # ------------------------------------------------------------ slot tasks
     def per_slot_task(self) -> None:
         """(reference: beacon_chain.rs per_slot_task via timer)"""

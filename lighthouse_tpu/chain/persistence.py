@@ -1,0 +1,307 @@
+"""Chain persistence — restart from disk (reference:
+beacon_chain/src/persisted_{beacon_chain,fork_choice}.rs +
+operation_pool/src/persistence.rs + fork_revert.rs).
+
+Everything the node needs to resume lives in the store:
+
+* ``PersistedForkChoice``  — proto-array nodes, vote trackers,
+  checkpoints (the reference's SSZ container, here a compact
+  hex-JSON encoding in the metadata column);
+* ``PersistedBeaconChain`` — head root + genesis root;
+* op-pool contents       — attestations and SigVerifiedOps re-encoded
+  as their SSZ containers.
+
+``save_chain`` writes all three; ``load_chain`` rebuilds a BeaconChain
+(falling back to ``reset_fork_choice_to_finalization`` — fork_revert.rs
+— when the persisted fork choice is missing or corrupt: replay hot
+blocks from the finalized snapshot).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..forkchoice import ExecutionStatus, ForkChoice
+from ..forkchoice.fork_choice import ForkChoiceStore
+from ..forkchoice.proto_array import VoteTracker
+
+KEY_PERSISTED_CHAIN = b"persisted_beacon_chain"
+KEY_PERSISTED_FORK_CHOICE = b"persisted_fork_choice"
+KEY_PERSISTED_OP_POOL = b"persisted_op_pool"
+
+
+def _hx(b: bytes | None) -> str | None:
+    return None if b is None else b.hex()
+
+
+def _unhx(s: str | None) -> bytes | None:
+    return None if s is None else bytes.fromhex(s)
+
+
+def _cp(t) -> list:
+    return [int(t[0]), t[1].hex()]
+
+
+def _uncp(v) -> tuple:
+    return (int(v[0]), bytes.fromhex(v[1]))
+
+
+# ------------------------------------------------------------- fork choice
+def serialize_fork_choice(fc: ForkChoice) -> bytes:
+    proto = fc.proto
+    nodes = []
+    for n in proto.proto_array.nodes:
+        nodes.append(
+            {
+                "slot": n.slot,
+                "root": _hx(n.root),
+                "state_root": _hx(n.state_root),
+                "target_root": _hx(n.target_root),
+                "parent": n.parent,
+                "jc": _cp(n.justified_checkpoint),
+                "fc": _cp(n.finalized_checkpoint),
+                "weight": n.weight,
+                "best_child": n.best_child,
+                "best_descendant": n.best_descendant,
+                "exec": n.execution_status.value,
+                "exec_hash": _hx(n.execution_block_hash),
+            }
+        )
+    votes = [
+        {"c": _hx(v.current_root), "n": _hx(v.next_root), "e": v.next_epoch}
+        for v in proto.votes
+    ]
+    store = fc.store
+    doc = {
+        "nodes": nodes,
+        "votes": votes,
+        "balances": list(proto.balances),
+        "justified": _cp(store.justified_checkpoint),
+        "finalized": _cp(store.finalized_checkpoint),
+        "best_justified": _cp(store.best_justified_checkpoint),
+        "equivocating": sorted(store.equivocating_indices),
+        "current_slot": fc._current_slot,
+        "genesis_time": fc.genesis_time,
+    }
+    return json.dumps(doc).encode()
+
+
+def deserialize_fork_choice(raw: bytes, spec, balances_fn) -> ForkChoice:
+    doc = json.loads(raw)
+    justified = _uncp(doc["justified"])
+    finalized = _uncp(doc["finalized"])
+
+    # rebuild through the anchor path then restore node/vote state
+    nodes = doc["nodes"]
+    if not nodes:
+        raise ValueError("persisted fork choice has no nodes")
+    from ..forkchoice.proto_array import ProtoArray, ProtoArrayForkChoice, _Node
+
+    proto = ProtoArrayForkChoice.__new__(ProtoArrayForkChoice)
+
+    proto.proto_array = ProtoArray(justified, finalized)
+    proto.votes = [
+        VoteTracker(
+            current_root=_unhx(v["c"]), next_root=_unhx(v["n"]),
+            next_epoch=int(v["e"]),
+        )
+        for v in doc["votes"]
+    ]
+    proto.balances = [int(b) for b in doc["balances"]]
+    for n in nodes:
+        node = _Node(
+            slot=int(n["slot"]),
+            root=_unhx(n["root"]),
+            state_root=_unhx(n["state_root"]),
+            target_root=_unhx(n["target_root"]),
+            parent=n["parent"],
+            justified_checkpoint=_uncp(n["jc"]),
+            finalized_checkpoint=_uncp(n["fc"]),
+            weight=int(n["weight"]),
+            best_child=n["best_child"],
+            best_descendant=n["best_descendant"],
+            execution_status=ExecutionStatus(n["exec"]),
+            execution_block_hash=_unhx(n["exec_hash"]),
+        )
+        proto.proto_array.indices[node.root] = len(proto.proto_array.nodes)
+        proto.proto_array.nodes.append(node)
+
+    store = ForkChoiceStore(
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        best_justified_checkpoint=_uncp(doc["best_justified"]),
+        justified_balances=[],
+        balances_fn=balances_fn,
+    )
+    store.equivocating_indices = set(doc["equivocating"])
+    store.refresh_justified_balances()
+    fc = ForkChoice(store, proto, spec, int(doc["genesis_time"]))
+    fc._current_slot = int(doc["current_slot"])
+    return fc
+
+
+# ----------------------------------------------------------------- op pool
+def serialize_op_pool(pool) -> bytes:
+    doc = {
+        "attestations": [
+            a.encode().hex() for a in pool.all_attestations()
+        ],
+        "proposer_slashings": [
+            {"op": op.operation.encode().hex(),
+             "vv": [[e, v.hex()] for e, v in op.verified_versions]}
+            for op in pool.proposer_slashings.values()
+        ],
+        "attester_slashings": [
+            {"op": op.operation.encode().hex(),
+             "vv": [[e, v.hex()] for e, v in op.verified_versions]}
+            for op in pool.attester_slashings
+        ],
+        "voluntary_exits": [
+            {"op": op.operation.encode().hex(),
+             "vv": [[e, v.hex()] for e, v in op.verified_versions]}
+            for op in pool.voluntary_exits.values()
+        ],
+    }
+    return json.dumps(doc).encode()
+
+
+def deserialize_into_op_pool(raw: bytes, pool, types) -> None:
+    from ..consensus.types import ProposerSlashing, SignedVoluntaryExit
+    from ..consensus.verify_operation import SigVerifiedOp
+
+    doc = json.loads(raw)
+
+    def unop(entry, cls):
+        return SigVerifiedOp(
+            cls.decode(bytes.fromhex(entry["op"])),
+            [(int(e), bytes.fromhex(v)) for e, v in entry["vv"]],
+        )
+
+    for hexed in doc["attestations"]:
+        pool.insert_attestation(types.Attestation.decode(bytes.fromhex(hexed)))
+    for entry in doc["proposer_slashings"]:
+        pool.insert_proposer_slashing(unop(entry, ProposerSlashing))
+    for entry in doc["attester_slashings"]:
+        pool.insert_attester_slashing(unop(entry, types.AttesterSlashing))
+    for entry in doc["voluntary_exits"]:
+        pool.insert_voluntary_exit(unop(entry, SignedVoluntaryExit))
+
+
+# ------------------------------------------------------------------- chain
+def save_chain(chain) -> None:
+    """Persist head pointer, fork choice, and op pool
+    (beacon_chain.rs persist_head + persist_fork_choice + persist_op_pool)."""
+    store = chain.store
+    store.put_meta(
+        KEY_PERSISTED_CHAIN,
+        json.dumps(
+            {
+                "head_root": chain.head().root.hex(),
+                "genesis_block_root": chain.genesis_block_root.hex(),
+                "finalized": _cp(chain.finalized_checkpoint()),
+                # the backend is part of chain identity: a fake-crypto
+                # chain must never resume under real verification
+                "backend": chain.backend,
+            }
+        ).encode(),
+    )
+    store.put_meta(KEY_PERSISTED_FORK_CHOICE, serialize_fork_choice(chain.fork_choice))
+    store.put_meta(KEY_PERSISTED_OP_POOL, serialize_op_pool(chain.op_pool))
+
+
+def load_chain(store, spec, slot_clock, backend=None):
+    """Rebuild a BeaconChain from a persisted store (the FromStore boot
+    path, builder.rs ClientGenesis::FromStore). ``backend=None`` resumes
+    with the backend the chain was persisted under."""
+    from .beacon_chain import BeaconChain
+
+    raw = store.get_meta(KEY_PERSISTED_CHAIN)
+    if raw is None:
+        raise ValueError("store holds no persisted chain")
+    doc = json.loads(raw)
+    head_root = bytes.fromhex(doc["head_root"])
+    genesis_block_root = bytes.fromhex(doc["genesis_block_root"])
+    if backend is None:
+        backend = doc.get("backend")
+
+    head_block = store.get_block(head_root)
+    if head_block is None:
+        raise ValueError("persisted head block missing")
+    head_state = store.get_state(bytes(head_block.message.state_root))
+    if head_state is None:
+        raise ValueError("persisted head state missing")
+
+    chain = BeaconChain.__new__(BeaconChain)
+    BeaconChain.__init__(
+        chain, spec, store, slot_clock, head_state, head_block,
+        genesis_block_root, backend,
+    )
+    # __init__ anchored fork choice at the head; replace with the
+    # persisted one (or rebuild from finalization if absent/corrupt)
+    raw_fc = store.get_meta(KEY_PERSISTED_FORK_CHOICE)
+    if raw_fc is not None:
+        try:
+            chain.fork_choice = deserialize_fork_choice(
+                raw_fc, spec, chain._justified_balances
+            )
+        except (ValueError, KeyError):
+            reset_fork_choice_to_finalization(chain)
+    else:
+        reset_fork_choice_to_finalization(chain)
+    chain.genesis_block_root = genesis_block_root
+    chain._finalized_checkpoint = _uncp(doc["finalized"])
+    from .beacon_chain import HeadInfo
+
+    chain._head = HeadInfo(head_root, head_block, head_state)
+    chain.snapshot_cache.insert(head_root, head_state.copy())
+
+    raw_pool = store.get_meta(KEY_PERSISTED_OP_POOL)
+    if raw_pool is not None:
+        try:
+            deserialize_into_op_pool(raw_pool, chain.op_pool, chain.types)
+        except (ValueError, KeyError):
+            pass  # op pool is best-effort state
+    return chain
+
+
+def reset_fork_choice_to_finalization(chain) -> None:
+    """fork_revert.rs reset_fork_choice_to_finalization: rebuild fork
+    choice anchored at the FINALIZED block and replay every descendant
+    block in the hot store on top (all branches, not just the head)."""
+    store = chain.store
+    fin_epoch, fin_root = chain.finalized_checkpoint()
+    anchor_root = fin_root
+    anchor_block = store.get_block(anchor_root)
+    anchor_state = None
+    if anchor_block is not None:
+        anchor_state = store.get_state(bytes(anchor_block.message.state_root))
+    if anchor_state is None:
+        # finalized snapshot unavailable (pruned): fall back to the head
+        head = chain.head()
+        anchor_root, anchor_state = head.root, head.state
+    chain.fork_choice = ForkChoice.from_anchor(
+        anchor_state, anchor_root, chain.spec,
+        balances_fn=chain._justified_balances,
+    )
+    # replay hot blocks above the anchor, parents before children
+    from ..store.hot_cold import COL_BLOCK
+
+    anchor_slot = int(anchor_state.slot)
+    blocks = []
+    for key, raw in store.db.iter_column(COL_BLOCK):
+        block = store._decode_block(raw)
+        if int(block.message.slot) > anchor_slot:
+            blocks.append((int(block.message.slot), key, block))
+    for slot, root, block in sorted(blocks, key=lambda x: x[0]):
+        state = store.get_state(bytes(block.message.state_root))
+        if state is None or not chain.fork_choice.contains_block(
+            bytes(block.message.parent_root)
+        ):
+            continue
+        try:
+            chain.fork_choice.on_block(
+                max(anchor_slot, slot), block.message, root, state,
+                execution_status=ExecutionStatus.IRRELEVANT,
+            )
+        except Exception:
+            continue

@@ -1,0 +1,95 @@
+"""Peer discovery (reference: lighthouse_network/src/discovery/ —
+discv5 UDP + ENR records with subnet advertisement bitfields; plus the
+standalone boot_node binary).
+
+The transport here is the in-process hub, so discovery reduces to a
+directory: nodes publish an ENR-like record (node id, attestation /
+sync subnet bitfields, fork digest) to the hub's registry; lookups
+filter records by predicate (subnet membership, fork digest) exactly
+where the reference filters ENRs. A BootNode is a hub member that only
+speaks discovery (serves the registry, relays records, no gossip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Enr:
+    """The advertisement record (discovery/enr.rs eth2/attnets/syncnets)."""
+
+    node_id: str
+    fork_digest: bytes = b"\x00" * 4
+    attnets: int = 0        # 64-bit attestation-subnet bitfield
+    syncnets: int = 0       # 4-bit sync-subnet bitfield
+    seq: int = 1
+
+    def advertises_attnet(self, subnet: int) -> bool:
+        return bool(self.attnets >> subnet & 1)
+
+    def advertises_syncnet(self, subnet: int) -> bool:
+        return bool(self.syncnets >> subnet & 1)
+
+
+class Discovery:
+    """Registry + lookup over hub membership (discovery/mod.rs)."""
+
+    def __init__(self, hub, local: Enr):
+        self.hub = hub
+        self.local = local
+        if not hasattr(hub, "enr_registry"):
+            hub.enr_registry = {}
+        hub.enr_registry[local.node_id] = local
+
+    def update_local(self, *, attnets: int | None = None,
+                     syncnets: int | None = None,
+                     fork_digest: bytes | None = None) -> None:
+        """Re-advertise (ENR sequence bump on change)."""
+        changed = False
+        if attnets is not None and attnets != self.local.attnets:
+            self.local.attnets = attnets
+            changed = True
+        if syncnets is not None and syncnets != self.local.syncnets:
+            self.local.syncnets = syncnets
+            changed = True
+        if fork_digest is not None and fork_digest != self.local.fork_digest:
+            self.local.fork_digest = fork_digest
+            changed = True
+        if changed:
+            self.local.seq += 1
+
+    # ---------------------------------------------------------------- lookup
+    def find_peers(self, predicate=None, limit: int = 16) -> list[Enr]:
+        """Filtered peer lookup (discovery lookups with subnet
+        predicates)."""
+        out = []
+        for node_id, enr in self.hub.enr_registry.items():
+            if node_id == self.local.node_id:
+                continue
+            if enr.fork_digest != self.local.fork_digest:
+                continue  # irrelevant network
+            if predicate is not None and not predicate(enr):
+                continue
+            out.append(enr)
+            if len(out) >= limit:
+                break
+        return out
+
+    def peers_on_attnet(self, subnet: int, limit: int = 16) -> list[Enr]:
+        return self.find_peers(lambda e: e.advertises_attnet(subnet), limit)
+
+    def peers_on_syncnet(self, subnet: int, limit: int = 16) -> list[Enr]:
+        return self.find_peers(lambda e: e.advertises_syncnet(subnet), limit)
+
+
+class BootNode:
+    """Discovery-only hub member (the boot_node binary): holds the
+    registry open and introduces peers; never subscribes to gossip."""
+
+    def __init__(self, hub, node_id: str = "boot"):
+        self.enr = Enr(node_id=node_id)
+        self.discovery = Discovery(hub, self.enr)
+
+    def known_peers(self) -> list[str]:
+        return [n for n in self.discovery.hub.enr_registry if n != self.enr.node_id]

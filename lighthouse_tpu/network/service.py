@@ -52,9 +52,35 @@ class NetworkService:
             ),
             chain.genesis_validators_root,
         )
+        from .discovery import Discovery, Enr
+
+        attnets = (
+            (1 << g.ATTESTATION_SUBNET_COUNT) - 1 if subscribe_all_subnets else 0
+        )
+        self.discovery = Discovery(
+            hub,
+            Enr(
+                node_id=node_id,
+                fork_digest=self.fork_digest,
+                attnets=attnets,
+                syncnets=(1 << g.SYNC_COMMITTEE_SUBNET_COUNT) - 1,
+            ),
+        )
         self._subscribe_topics(subscribe_all_subnets)
         self._register_rpc()
         self.peer.on_gossip = self._on_gossip
+
+    def discover_and_connect(self, limit: int = 16) -> int:
+        """Discovery round: handshake not-yet-connected same-fork peers
+        (the dial-from-discovery loop). The connected filter sits inside
+        the lookup so already-dialed peers don't exhaust the limit."""
+        connected = 0
+        for enr in self.discovery.find_peers(
+            lambda e: not self.peer_manager.is_connected(e.node_id), limit
+        ):
+            if self.send_status(enr.node_id) is not None:
+                connected += 1
+        return connected
 
     # --------------------------------------------------------------- topics
     def _subscribe_topics(self, all_subnets: bool) -> None:

@@ -80,6 +80,7 @@ class BeaconNode:
         chain = self.chain
         chain.per_slot_task()
         if self.network is not None:
+            self.network.discover_and_connect()
             self.network.poll()
         if self.slasher is not None:
             p = self.spec.preset
@@ -146,6 +147,10 @@ class BeaconNode:
         return self
 
     def stop(self) -> None:
+        try:
+            self.chain.persist()  # resume-safe shutdown
+        except Exception:
+            self.log.warn("chain persistence failed on shutdown")
         self.executor.shutdown.trigger("node stopped")
         if self.http is not None:
             self.http.stop()
@@ -228,8 +233,24 @@ class ClientBuilder:
         )
         clock_cls = ManualSlotClock if cfg.manual_clock else SystemSlotClock
 
+        from ..chain.persistence import KEY_PERSISTED_CHAIN, load_chain
+
         if self._checkpoint_client is not None:
             chain = self._build_from_checkpoint(hot_cold, clock_cls)
+        elif (
+            self._genesis_state is None
+            and hot_cold.get_meta(KEY_PERSISTED_CHAIN) is not None
+        ):
+            # resume-from-store boot (ClientGenesis::FromStore). Load with
+            # a frozen manual clock (no giant slot numbers during replay),
+            # then install the real clock positioned at the head slot.
+            probe_clock = ManualSlotClock(0, self.spec.SECONDS_PER_SLOT)
+            chain = load_chain(hot_cold, self.spec, probe_clock, backend=cfg.backend)
+            genesis_time = int(chain.head().state.genesis_time)
+            clock = clock_cls(genesis_time, self.spec.SECONDS_PER_SLOT)
+            if isinstance(clock, ManualSlotClock):
+                clock.set_slot(int(chain.head().block.message.slot))
+            chain.slot_clock = clock
         else:
             if self._genesis_state is None:
                 self.interop_genesis()

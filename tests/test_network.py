@@ -298,3 +298,40 @@ class TestNetworkIntegration:
         n2.poll()
         assert n2.router.stats["ops_accepted"] == 1
         assert 3 in h2.chain.op_pool.voluntary_exits
+
+
+class TestDiscovery:
+    def test_registry_and_subnet_lookup(self):
+        from lighthouse_tpu.network.discovery import BootNode, Discovery, Enr
+
+        hub = InMemoryHub()
+        boot = BootNode(hub)
+        d1 = Discovery(hub, Enr(node_id="a", attnets=0b0101))
+        d2 = Discovery(hub, Enr(node_id="b", attnets=0b0010))
+        assert set(boot.known_peers()) == {"a", "b"}
+        assert [e.node_id for e in d1.peers_on_attnet(1)] == ["b"]
+        assert [e.node_id for e in d2.peers_on_attnet(0)] == ["a"]
+        # fork digest filtering
+        Discovery(hub, Enr(node_id="c", fork_digest=b"\x01\x02\x03\x04"))
+        assert all(e.node_id != "c" for e in d1.find_peers())
+
+    def test_enr_seq_bumps_on_change(self):
+        from lighthouse_tpu.network.discovery import Discovery, Enr
+
+        hub = InMemoryHub()
+        d = Discovery(hub, Enr(node_id="a"))
+        assert d.local.seq == 1
+        d.update_local(attnets=0b1)
+        assert d.local.seq == 2
+        d.update_local(attnets=0b1)  # no change
+        assert d.local.seq == 2
+
+    def test_discover_and_connect(self):
+        hub = InMemoryHub()
+        h1 = BeaconChainHarness(validator_count=16)
+        h2 = BeaconChainHarness(validator_count=16)
+        n1 = NetworkService(h1.chain, hub, "node1")
+        n2 = NetworkService(h2.chain, hub, "node2")
+        connected = n1.discover_and_connect()
+        assert connected == 1
+        assert n1.peer_manager.is_connected("node2")
