@@ -756,6 +756,130 @@ class BeaconApi:
             return {"data": "Synced"}
         return {"data": self.network.sync.state.value}
 
+    def lighthouse_database_info(self) -> dict:
+        """GET /lighthouse/database (http_api/src/database.rs)."""
+        from ..store.hot_cold import COL_BLOCK, COL_STATE, COL_SUMMARY
+        from ..store.schema_change import read_schema_version
+
+        store = self.chain.store
+
+        def count_keys(column: bytes) -> int:
+            # key-only when the engine offers it; values untouched
+            iter_keys = getattr(store.db, "iter_keys", None)
+            if iter_keys is not None:
+                return sum(1 for _ in iter_keys(column))
+            return sum(1 for _ in store.db.iter_column(column))
+
+        counts = {
+            "blocks": count_keys(COL_BLOCK),
+            "hot_states": count_keys(COL_STATE),
+            "summaries": count_keys(COL_SUMMARY),
+        }
+        return {
+            "data": {
+                "schema_version": read_schema_version(store.db),
+                "split_slot": str(store.split.slot),
+                "slots_per_restore_point": str(
+                    store.config.slots_per_restore_point
+                ),
+                "counts": counts,
+            }
+        }
+
+    def lighthouse_block_rewards(self, start_slot: int, end_slot: int) -> dict:
+        """GET /lighthouse/analysis/block_rewards
+        (http_api/src/block_rewards.rs, condensed): per-block counts of
+        included operations (the reward drivers)."""
+        start, end = int(start_slot), int(end_slot)
+        _bad(start <= end, "inverted slot range")
+        _bad(end - start <= 256, "slot range too large")
+        head = self.chain.head()
+        head_slot = int(head.block.message.slot)
+        try:
+            pairs = [
+                (slot, root)
+                for slot, root in self.chain.store.forwards_block_roots_iterator(
+                    start, min(end, head_slot), head.state
+                )
+            ]
+        except Exception as e:
+            # e.g. a slot above the split but outside the head state's
+            # root window (stalled finality): a client error, not a 500
+            raise ApiError(400, f"slot range unavailable: {e}")
+        # the iterator covers roots recorded BEHIND the head state; the
+        # head block itself is appended explicitly
+        if start <= head_slot <= end:
+            pairs.append((head_slot, head.root))
+        out = []
+        for slot, root in pairs:
+            block = self.chain.store.get_block(root)
+            if block is None or int(block.message.slot) != slot:
+                continue
+            body = block.message.body
+            out.append(
+                {
+                    "block_root": "0x" + root.hex(),
+                    "slot": str(slot),
+                    "attestations": len(body.attestations),
+                    "proposer_slashings": len(body.proposer_slashings),
+                    "attester_slashings": len(body.attester_slashings),
+                    "sync_participation": (
+                        sum(body.sync_aggregate.sync_committee_bits)
+                        if hasattr(body, "sync_aggregate")
+                        else 0
+                    ),
+                }
+            )
+        return {"data": out}
+
+    def lighthouse_attestation_performance(self, validator_index: int,
+                                           start_epoch: int,
+                                           end_epoch: int) -> dict:
+        """GET /lighthouse/analysis/attestation_performance
+        (attestation_performance.rs, backed by the validator monitor)."""
+        vi = int(validator_index)
+        start_epoch, end_epoch = int(start_epoch), int(end_epoch)
+        _bad(start_epoch <= end_epoch, "inverted epoch range")
+        _bad(end_epoch - start_epoch <= 256, "epoch range too large")
+        monitor = self.chain.validator_monitor
+        out = []
+        for epoch in range(start_epoch, end_epoch + 1):
+            summary = monitor.summaries.get(vi, {}).get(epoch)
+            out.append(
+                {
+                    "epoch": str(epoch),
+                    "attestations_seen": summary.attestations_seen if summary else 0,
+                    "attestations_in_block": (
+                        summary.attestations_in_block if summary else 0
+                    ),
+                    "min_inclusion_delay": (
+                        summary.min_inclusion_delay if summary else None
+                    ),
+                }
+            )
+        return {"data": {"validator_index": str(vi), "epochs": out}}
+
+    def lighthouse_block_packing_efficiency(self, start_slot: int,
+                                            end_slot: int) -> dict:
+        """GET /lighthouse/analysis/block_packing_efficiency: included
+        attestations vs the per-block ceiling."""
+        p = self.chain.spec.preset
+        rewards = self.lighthouse_block_rewards(start_slot, end_slot)["data"]
+        out = []
+        for r in rewards:
+            out.append(
+                {
+                    "block_root": r["block_root"],
+                    "slot": r["slot"],
+                    "included_attestations": r["attestations"],
+                    "max_attestations": p.MAX_ATTESTATIONS,
+                    "efficiency": round(
+                        r["attestations"] / max(1, p.MAX_ATTESTATIONS), 4
+                    ),
+                }
+            )
+        return {"data": out}
+
     def lighthouse_proto_array(self) -> dict:
         proto = self.chain.fork_choice.proto.proto_array
         return {

@@ -63,6 +63,10 @@ route("POST", r"/eth/v1/validator/aggregate_and_proofs", "publish_aggregate_and_
 route("POST", r"/eth/v1/validator/beacon_committee_subscriptions", "subscribe_beacon_committee")
 route("GET", r"/lighthouse/syncing", "lighthouse_syncing_state")
 route("GET", r"/lighthouse/proto_array", "lighthouse_proto_array")
+route("GET", r"/lighthouse/database", "lighthouse_database_info")
+route("GET", r"/lighthouse/analysis/block_rewards", "lighthouse_block_rewards")
+route("GET", r"/lighthouse/analysis/block_packing_efficiency", "lighthouse_block_packing_efficiency")
+route("GET", r"/lighthouse/analysis/attestation_performance/(?P<validator_index>\d+)", "lighthouse_attestation_performance", ("validator_index",))
 
 # handlers whose body is the single positional payload
 BODY_AS_PAYLOAD = {
@@ -86,9 +90,13 @@ QUERY_KWARGS = {
     "sync_committee_contribution": (
         "slot", "subcommittee_index", "beacon_block_root",
     ),
+    "lighthouse_block_rewards": ("start_slot", "end_slot"),
+    "lighthouse_block_packing_efficiency": ("start_slot", "end_slot"),
+    "lighthouse_attestation_performance": ("start_epoch", "end_epoch"),
 }
 INT_QUERY_PARAMS = {"epoch", "index", "slot", "committee_index",
-                    "subcommittee_index"}
+                    "subcommittee_index", "start_slot", "end_slot",
+                    "start_epoch", "end_epoch"}
 
 
 class HttpServer:

@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
     imp = asub.add_parser("inspect", help="inspect a keystore")
     imp.add_argument("path")
     imp.add_argument("--password", default=None)
+    sp = asub.add_parser(
+        "slashing-protection", help="EIP-3076 interchange import/export"
+    )
+    sp.add_argument("action2", choices=("export", "import"),
+                    metavar="export|import")
+    sp.add_argument("--db", required=True,
+                    help="slashing protection SQLite path")
+    sp.add_argument("--genesis-validators-root", required=True)
+    sp.add_argument("--file", default="-")
 
     lcli = sub.add_parser("lcli", help="dev utilities")
     _add_common(lcli)
@@ -224,6 +233,35 @@ def run_account(args) -> int:
             ks.decrypt(args.password)
             info["decrypts"] = True
         print(json.dumps(info, indent=2))
+        return 0
+    if args.action == "slashing-protection":
+        from .validator.slashing_protection import SlashingDatabase
+
+        db = SlashingDatabase(args.db)
+        gvr = bytes.fromhex(
+            args.genesis_validators_root.removeprefix("0x")
+        )
+        if args.action2 == "export":
+            out = json.dumps(db.export_interchange(gvr), indent=2)
+            if args.file == "-":
+                print(out)
+            else:
+                with open(args.file, "w") as f:
+                    f.write(out)
+            return 0
+        if args.file == "-":
+            data = sys.stdin.read()
+        else:
+            with open(args.file) as f:
+                data = f.read()
+        from .validator.slashing_protection import SlashingError
+
+        try:
+            count = db.import_interchange(data, gvr)
+        except SlashingError as e:
+            print(json.dumps({"error": str(e)}), file=sys.stderr)
+            return 1
+        print(json.dumps({"imported_validators": count}))
         return 0
     return 1
 

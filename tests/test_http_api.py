@@ -2,6 +2,8 @@
 and the real HTTP server + typed client end-to-end (reference test
 model: http_api tests over a harness chain)."""
 
+import json
+
 import pytest
 
 from lighthouse_tpu.api import (
@@ -188,3 +190,69 @@ class TestHttpTransport:
         _, srv = server
         with urllib.request.urlopen(srv.url + "/eth/v1/node/health") as resp:
             assert resp.status == 200
+
+
+class TestLighthouseAnalysis:
+    @pytest.fixture(scope="class")
+    def grown(self):
+        h = BeaconChainHarness(validator_count=16)
+        h.chain.validator_monitor.auto_register = True
+        h.extend_chain(4)
+        return h, BeaconApi(h.chain)
+
+    def test_database_info(self, grown):
+        h, api = grown
+        info = api.lighthouse_database_info()["data"]
+        assert info["schema_version"] == 1
+        assert info["counts"]["blocks"] >= 5  # genesis + 4
+
+    def test_block_rewards_and_packing(self, grown):
+        h, api = grown
+        rewards = api.lighthouse_block_rewards(1, 4)["data"]
+        assert len(rewards) == 4
+        assert all(int(r["slot"]) in range(1, 5) for r in rewards)
+        packing = api.lighthouse_block_packing_efficiency(1, 4)["data"]
+        assert len(packing) == 4
+        assert all(0 <= p["efficiency"] <= 1 for p in packing)
+
+    def test_attestation_performance(self, grown):
+        h, api = grown
+        perf = api.lighthouse_attestation_performance(0, 0, 0)["data"]
+        assert perf["validator_index"] == "0"
+        assert len(perf["epochs"]) == 1
+
+    def test_range_bound(self, grown):
+        h, api = grown
+        with pytest.raises(ApiError):
+            api.lighthouse_block_rewards(0, 10_000)
+
+
+class TestSlashingProtectionCli:
+    def test_export_import_roundtrip(self, tmp_path, capsys):
+        from lighthouse_tpu.cli import main
+        from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+        db_path = str(tmp_path / "sp.sqlite")
+        db = SlashingDatabase(db_path)
+        db.register_validator(b"\xaa" * 48)
+        db.check_and_insert_block_proposal(b"\xaa" * 48, 7, b"r")
+        db.close()
+
+        gvr = "0x" + "11" * 32
+        out_file = str(tmp_path / "interchange.json")
+        rc = main(["account", "slashing-protection", "export",
+                   "--db", db_path, "--genesis-validators-root", gvr,
+                   "--file", out_file])
+        assert rc == 0
+        db2_path = str(tmp_path / "sp2.sqlite")
+        rc = main(["account", "slashing-protection", "import",
+                   "--db", db2_path, "--genesis-validators-root", gvr,
+                   "--file", out_file])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["imported_validators"] == 1
+        db2 = SlashingDatabase(db2_path)
+        from lighthouse_tpu.validator.slashing_protection import SlashingError
+
+        with pytest.raises(SlashingError):
+            db2.check_and_insert_block_proposal(b"\xaa" * 48, 7, b"x")
