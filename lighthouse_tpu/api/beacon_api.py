@@ -748,7 +748,92 @@ class BeaconApi:
         return {}
 
     def subscribe_beacon_committee(self, subscriptions) -> dict:
-        return {}  # subnet subscriptions are a no-op on the full-mesh hub
+        """POST validator/beacon_committee_subscriptions → the attestation
+        subnet service (http_api post_validator_beacon_committee_
+        subscriptions → AttestationService.validator_subscriptions)."""
+        if self.network is not None:
+            from ..network.subnet_service import ValidatorSubscription
+
+            self.network.process_attester_subscriptions([
+                ValidatorSubscription(
+                    validator_index=int(s["validator_index"]),
+                    committee_index=int(s["committee_index"]),
+                    slot=int(s["slot"]),
+                    committee_count_at_slot=int(s["committees_at_slot"]),
+                    is_aggregator=bool(s.get("is_aggregator", False)),
+                )
+                for s in subscriptions
+            ])
+        return {}
+
+    def subscribe_sync_committee(self, subscriptions) -> dict:
+        """POST validator/sync_committee_subscriptions → sync subnet
+        service (sync_subnets.rs path)."""
+        if self.network is not None:
+            from ..network.subnet_service import SyncCommitteeSubscription
+
+            self.network.process_sync_subscriptions([
+                SyncCommitteeSubscription(
+                    validator_index=int(s["validator_index"]),
+                    sync_committee_indices=tuple(
+                        int(i) for i in s["sync_committee_indices"]
+                    ),
+                    until_epoch=int(s["until_epoch"]),
+                )
+                for s in subscriptions
+            ])
+        return {}
+
+    def pool_proposer_slashings(self, slashing_json_or_obj) -> dict:
+        """POST beacon/pool/proposer_slashings (gossip-verify + pool +
+        publish, http_api pool handlers)."""
+        from ..consensus.types import ProposerSlashing
+        from ..consensus.verify_operation import (
+            OperationError,
+            verify_proposer_slashing,
+        )
+
+        chain = self.chain
+        slashing = (
+            container_from_json(ProposerSlashing, slashing_json_or_obj)
+            if isinstance(slashing_json_or_obj, dict)
+            else slashing_json_or_obj
+        )
+        try:
+            op = verify_proposer_slashing(
+                chain.head().state, slashing, chain.spec, backend=chain.backend
+            )
+        except OperationError as e:
+            raise ApiError(400, f"proposer slashing rejected: {e}")
+        chain.op_pool.insert_proposer_slashing(op)
+        if self.network is not None:
+            self.network.publish_proposer_slashing(slashing)
+        return {}
+
+    def pool_attester_slashings(self, slashing_json_or_obj) -> dict:
+        from ..consensus.verify_operation import (
+            OperationError,
+            verify_attester_slashing,
+        )
+
+        chain = self.chain
+        slashing = (
+            container_from_json(
+                self.chain.types.AttesterSlashing, slashing_json_or_obj
+            )
+            if isinstance(slashing_json_or_obj, dict)
+            else slashing_json_or_obj
+        )
+        try:
+            op = verify_attester_slashing(
+                chain.head().state, slashing, chain.spec, backend=chain.backend
+            )
+        except OperationError as e:
+            raise ApiError(400, f"attester slashing rejected: {e}")
+        chain.op_pool.insert_attester_slashing(op)
+        if self.network is not None:
+            self.network.publish_attester_slashing(slashing)
+        return {}
 
     # ------------------------------------------------------------ /lighthouse
     def lighthouse_syncing_state(self) -> dict:

@@ -128,6 +128,34 @@ class BeaconNodeClient:
             body=exit_json,
         )
 
+    def post_proposer_slashing(self, slashing_json):
+        return self._post(
+            "/eth/v1/beacon/pool/proposer_slashings",
+            lambda: self.api.pool_proposer_slashings(slashing_json),
+            body=slashing_json,
+        )
+
+    def post_attester_slashing(self, slashing_json):
+        return self._post(
+            "/eth/v1/beacon/pool/attester_slashings",
+            lambda: self.api.pool_attester_slashings(slashing_json),
+            body=slashing_json,
+        )
+
+    def post_beacon_committee_subscriptions(self, subscriptions_json):
+        return self._post(
+            "/eth/v1/validator/beacon_committee_subscriptions",
+            lambda: self.api.subscribe_beacon_committee(subscriptions_json),
+            body=subscriptions_json,
+        )
+
+    def post_sync_committee_subscriptions(self, subscriptions_json):
+        return self._post(
+            "/eth/v1/validator/sync_committee_subscriptions",
+            lambda: self.api.subscribe_sync_committee(subscriptions_json),
+            body=subscriptions_json,
+        )
+
     def get_debug_state(self, state_id="head"):
         return self._get(
             f"/eth/v2/debug/beacon/states/{state_id}",

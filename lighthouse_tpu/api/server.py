@@ -42,6 +42,8 @@ route("POST", r"/eth/v1/beacon/blocks", "publish_block")
 route("POST", r"/eth/v1/beacon/pool/attestations", "pool_attestations")
 route("GET", r"/eth/v1/beacon/pool/attestations", "get_pool_attestations")
 route("POST", r"/eth/v1/beacon/pool/voluntary_exits", "pool_voluntary_exit")
+route("POST", r"/eth/v1/beacon/pool/proposer_slashings", "pool_proposer_slashings")
+route("POST", r"/eth/v1/beacon/pool/attester_slashings", "pool_attester_slashings")
 route("POST", r"/eth/v1/beacon/pool/sync_committees", "pool_sync_committees")
 route("GET", r"/eth/v1/validator/sync_committee_contribution", "sync_committee_contribution")
 route("POST", r"/eth/v1/validator/contribution_and_proofs", "publish_contribution_and_proofs")
@@ -61,6 +63,7 @@ route("GET", r"/eth/v1/validator/attestation_data", "attestation_data")
 route("GET", r"/eth/v1/validator/aggregate_attestation", "aggregate_attestation")
 route("POST", r"/eth/v1/validator/aggregate_and_proofs", "publish_aggregate_and_proofs")
 route("POST", r"/eth/v1/validator/beacon_committee_subscriptions", "subscribe_beacon_committee")
+route("POST", r"/eth/v1/validator/sync_committee_subscriptions", "subscribe_sync_committee")
 route("GET", r"/lighthouse/syncing", "lighthouse_syncing_state")
 route("GET", r"/lighthouse/proto_array", "lighthouse_proto_array")
 route("GET", r"/lighthouse/database", "lighthouse_database_info")
@@ -77,6 +80,9 @@ BODY_AS_PAYLOAD = {
     "publish_aggregate_and_proofs",
     "publish_contribution_and_proofs",
     "subscribe_beacon_committee",
+    "subscribe_sync_committee",
+    "pool_proposer_slashings",
+    "pool_attester_slashings",
 }
 # query params forwarded as keyword arguments (ints where sensible)
 QUERY_KWARGS = {

@@ -55,6 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--interop-validators", type=int, default=64)
     bn.add_argument("--checkpoint-sync-url", default=None)
+    bn.add_argument("--boot-nodes", default=None,
+                    help="comma-separated bootnode URLs to register with")
     bn.add_argument("--backend", default=None,
                     choices=(None, "python", "jax", "fake"))
     bn.add_argument("--slots", type=int, default=0,
@@ -106,6 +108,17 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--type", dest="ssz_type", required=True,
                     choices=("attestation", "signed_block", "state"))
     ps.add_argument("path")
+    tb = lsub.add_parser("transition-blocks",
+                         help="apply SSZ block(s) to an SSZ pre-state")
+    tb.add_argument("--pre-state", required=True)
+    tb.add_argument("--block", required=True, nargs="+")
+    tb.add_argument("--post-state", default=None,
+                    help="write the post state SSZ here")
+    tb.add_argument("--no-signature-verification", action="store_true")
+    iv = lsub.add_parser("insecure-validators",
+                         help="write interop keystores + secrets dir")
+    iv.add_argument("--count", type=int, required=True)
+    iv.add_argument("--base-dir", required=True)
 
     db = sub.add_parser("db", help="database tooling")
     _add_common(db)
@@ -115,14 +128,23 @@ def build_parser() -> argparse.ArgumentParser:
     bench = sub.add_parser("bench", help="BLS device benchmark")
     bench.add_argument("--quick", action="store_true")
 
+    boot = sub.add_parser(
+        "boot-node", help="standalone discovery-only bootnode (boot_node binary)"
+    )
+    _add_common(boot)
+    boot.add_argument("--port", type=int, default=0)
+    boot.add_argument("--host", default="127.0.0.1")
+
     return root
 
 
 # ------------------------------------------------------------------ commands
 def run_bn(args) -> int:
     from .common.logging import StructuredLogger
+    from .common.malloc_utils import configure_memory_allocator
     from .node import ClientBuilder, ClientConfig
 
+    configure_memory_allocator()  # lighthouse/src/main.rs does this first
     log = StructuredLogger(level=args.debug_level)
     spec = _spec_for(args.spec)
     cfg = ClientConfig(
@@ -149,6 +171,15 @@ def run_bn(args) -> int:
     else:
         builder.interop_genesis()
     node = builder.build()
+    if args.boot_nodes and node.network is not None:
+        from .network.discovery import sync_with_boot_node
+
+        for url in args.boot_nodes.split(","):
+            try:
+                learned = sync_with_boot_node(node.network.discovery, url.strip())
+                log.info("bootnode sync", url=url.strip(), learned=learned)
+            except (OSError, ValueError, KeyError) as e:
+                log.warn("bootnode unusable", url=url.strip(), error=repr(e))
     log.info(
         "beacon node ready",
         spec=args.spec,
@@ -317,6 +348,91 @@ def run_lcli(args) -> int:
 
         print(json.dumps(container_to_json(cls.decode(raw)), indent=2))
         return 0
+    if args.action == "transition-blocks":
+        # lcli/src/transition_blocks.rs: replay blocks onto a pre-state
+        from .consensus.transition.block import (
+            SignatureStrategy,
+            per_block_processing,
+        )
+        from .consensus.transition.slot import process_slots
+        from .consensus.types import spec_types, state_fork_name
+
+        t = spec_types(spec.preset)
+        with open(args.pre_state, "rb") as f:
+            raw = f.read()
+        # the SSZ state has no self-describing tag: pick the fork class
+        # whose schema round-trips (newest first — later forks are
+        # supersets and would mis-decode under older schemas)
+        state = None
+        for fork in ("bellatrix", "altair", "phase0"):
+            try:
+                candidate = t.STATE_BY_FORK[fork].decode(raw)
+                if spec.fork_name_at_epoch(
+                    int(candidate.slot) // spec.preset.SLOTS_PER_EPOCH
+                ) == fork:
+                    state = candidate
+                    break
+            except Exception:
+                continue
+        if state is None:
+            print(json.dumps({"error": "undecodable pre-state"}),
+                  file=sys.stderr)
+            return 1
+        strategy = (
+            SignatureStrategy.NO_VERIFICATION
+            if args.no_signature_verification
+            else SignatureStrategy.VERIFY_BULK
+        )
+        for path in args.block:
+            with open(path, "rb") as f:
+                block_raw = f.read()
+            # message.slot: first field of the message, which starts at
+            # the 4-byte variable-offset recorded at the front
+            msg_off = int.from_bytes(block_raw[:4], "little")
+            slot = int.from_bytes(block_raw[msg_off:msg_off + 8], "little")
+            if int(state.slot) < slot:
+                state = process_slots(state, slot, spec)
+            # block class chosen AFTER the advance (fork upgrades happen
+            # at epoch boundaries inside process_slots)
+            signed = t.SIGNED_BLOCK_BY_FORK[state_fork_name(state)].decode(
+                block_raw
+            )
+            per_block_processing(state, signed, spec, strategy=strategy)
+        out = state.encode()
+        if args.post_state:
+            with open(args.post_state, "wb") as f:
+                f.write(out)
+        print(json.dumps({
+            "slot": int(state.slot),
+            "state_root": "0x" + state.hash_tree_root().hex(),
+        }))
+        return 0
+    if args.action == "insecure-validators":
+        # lcli insecure_validators: deterministic interop keys, encrypted
+        # under a per-key password file (validator_dir layout)
+        import os
+
+        from .consensus.genesis import interop_keypairs
+        from .validator.keystore import Keystore
+
+        os.makedirs(os.path.join(args.base_dir, "validators"), exist_ok=True)
+        os.makedirs(os.path.join(args.base_dir, "secrets"), exist_ok=True)
+        for i, sk in enumerate(interop_keypairs(args.count)):
+            pubkey = sk.public_key().to_bytes().hex()
+            password = f"insecure-password-{i}"
+            ks = Keystore.encrypt(sk, password, kdf="pbkdf2",
+                                  path=f"m/12381/3600/{i}/0/0")
+            vdir = os.path.join(args.base_dir, "validators", f"0x{pubkey}")
+            os.makedirs(vdir, exist_ok=True)
+            with open(os.path.join(vdir, "voting-keystore.json"), "w") as f:
+                f.write(ks.to_json())
+            with open(
+                os.path.join(args.base_dir, "secrets", f"0x{pubkey}"), "w"
+            ) as f:
+                f.write(password)
+        print(json.dumps({"validators_written": args.count,
+                          "base_dir": args.base_dir}))
+        return 0
     return 1
 
 
@@ -341,6 +457,20 @@ def run_bench(args) -> int:
     return subprocess.call(cmd)
 
 
+def run_boot_node(args) -> int:
+    from .common.logging import StructuredLogger
+    from .network.discovery import BootNodeServer
+
+    log = StructuredLogger(level=args.debug_level)
+    server = BootNodeServer(host=args.host, port=args.port)
+    log.info("boot node listening", url=server.url)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {
@@ -350,6 +480,7 @@ def main(argv=None) -> int:
         "lcli": run_lcli,
         "db": run_db,
         "bench": run_bench,
+        "boot-node": run_boot_node,
     }[args.command](args)
 
 

@@ -3,4 +3,13 @@
 from .logging import NullLogger, StructuredLogger, test_logger  # noqa: F401
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry  # noqa: F401
 from .slot_clock import ManualSlotClock, SlotClock, SystemSlotClock  # noqa: F401
+from .support import (  # noqa: F401
+    Fallback,
+    FallbackError,
+    HashSetDelay,
+    Lockfile,
+    LockfileError,
+    LRUTimeCache,
+    SensitiveUrl,
+)
 from .task_executor import ShutdownSignal, TaskExecutor  # noqa: F401

@@ -1,0 +1,85 @@
+"""glibc allocator tuning + metrics.
+
+Capability mirror of `common/malloc_utils` (src/lib.rs:1-30 + glibc.rs):
+the reference caps glibc malloc arena count and trim/mmap thresholds at
+startup (long-running beacon nodes otherwise accumulate per-thread
+arenas and fragment), and scrapes ``mallinfo`` into metrics. Here the
+same knobs are driven through ``mallopt(3)`` via ctypes; on non-glibc
+platforms every call degrades to a no-op, like the reference's
+conditional compilation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import sys
+
+# glibc mallopt parameter numbers (malloc.h)
+M_MMAP_THRESHOLD = -3
+M_ARENA_MAX = -8
+M_TRIM_THRESHOLD = -1
+
+# Reference defaults (glibc.rs): 4 arenas, 1 MiB mmap/trim thresholds.
+DEFAULT_ARENA_MAX = 4
+DEFAULT_MMAP_THRESHOLD = 2 * 1024 * 1024
+DEFAULT_TRIM_THRESHOLD = 2 * 1024 * 1024
+
+_libc = None
+
+
+def _glibc():
+    global _libc
+    if _libc is None:
+        if not sys.platform.startswith("linux"):
+            _libc = False
+        else:
+            try:
+                lib = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6")
+                lib.mallopt  # glibc only
+                _libc = lib
+            except (OSError, AttributeError):
+                _libc = False
+    return _libc or None
+
+
+def configure_memory_allocator(
+    arena_max: int = DEFAULT_ARENA_MAX,
+    mmap_threshold: int = DEFAULT_MMAP_THRESHOLD,
+    trim_threshold: int = DEFAULT_TRIM_THRESHOLD,
+) -> bool:
+    """Apply the allocator tuning; returns False on non-glibc (no-op)."""
+    lib = _glibc()
+    if lib is None:
+        return False
+    ok = True
+    for param, value in (
+        (M_ARENA_MAX, arena_max),
+        (M_MMAP_THRESHOLD, mmap_threshold),
+        (M_TRIM_THRESHOLD, trim_threshold),
+    ):
+        if value is not None and lib.mallopt(param, value) != 1:
+            ok = False
+    return ok
+
+
+class _Mallinfo2(ctypes.Structure):
+    _fields_ = [(name, ctypes.c_size_t) for name in (
+        "arena", "ordblks", "smblks", "hblks", "hblkhd",
+        "usmblks", "fsmblks", "uordblks", "fordblks", "keepcost",
+    )]
+
+
+def scrape_allocator_metrics() -> dict[str, int]:
+    """mallinfo2 snapshot → metric dict (glibc.rs
+    scrape_mallinfo_metrics); empty on non-glibc."""
+    lib = _glibc()
+    if lib is None:
+        return {}
+    try:
+        fn = lib.mallinfo2
+    except AttributeError:
+        return {}
+    fn.restype = _Mallinfo2
+    info = fn()
+    return {name: int(getattr(info, name)) for name, _ in _Mallinfo2._fields_}

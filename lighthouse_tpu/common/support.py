@@ -1,0 +1,282 @@
+"""Small cross-cutting support utilities.
+
+Capability mirrors of the reference's little `common/*` crates:
+
+* ``Fallback``     — ordered multi-endpoint first-success dispatch
+  (`common/fallback/src/lib.rs`; the generic core under eth1/execution
+  endpoint failover).
+* ``HashSetDelay`` — a set whose entries expire after a per-entry delay
+  (`common/hashset_delay`; backs subnet-service and peer-manager timeouts).
+* ``LRUTimeCache`` — "seen recently" dedup cache bounded by age and size
+  (`common/lru_cache/src/time_cache.rs`).
+* ``Lockfile``     — pidfile advisory lock guarding datadirs/keystores
+  (`common/lockfile/src/lib.rs`).
+* ``SensitiveUrl`` — URL wrapper that never displays credentials
+  (`common/sensitive_url/src/lib.rs`).
+
+Time-taking structures accept explicit ``now`` values (seconds, any
+monotonic base) so they stay deterministic under the ManualSlotClock
+test model; passing ``None`` uses wall time.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from urllib.parse import urlparse, urlunparse
+
+
+class JsonHttpHandler:
+    """Mixin for BaseHTTPRequestHandler subclasses: silent logging plus
+    JSON read/write helpers. Shared by every in-process HTTP service
+    (bootnode registry, Web3Signer, mock builder, …)."""
+
+    def log_message(self, *args):  # noqa: D102 — BaseHTTPRequestHandler hook
+        pass
+
+    def send_json(self, status: int, body=None) -> None:
+        import json as _json
+
+        raw = b"" if body is None else _json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def read_json(self):
+        """Parse the request body; raises ValueError on bad JSON."""
+        import json as _json
+
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length) if length else b""
+        return _json.loads(raw) if raw else None
+
+
+class HttpServerLifecycle:
+    """Owns a ThreadingHTTPServer on an ephemeral port with daemon-thread
+    start/stop semantics. Subclasses call ``_init_http(handler_cls, host,
+    port)`` from their __init__."""
+
+    def _init_http(self, handler_cls, host: str, port: int) -> None:
+        import threading
+        from http.server import ThreadingHTTPServer
+
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.url = f"http://{host}:{self._httpd.server_address[1]}"
+        self._thread: "threading.Thread | None" = None
+        self._threading = threading
+
+    def start(self):
+        self._thread = self._threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+class FallbackError(Exception):
+    """All candidates failed; carries the per-candidate errors."""
+
+    def __init__(self, errors):
+        self.errors = errors
+        super().__init__(
+            "all fallbacks failed: "
+            + "; ".join(f"{name}: {err}" for name, err in errors)
+        )
+
+
+class Fallback:
+    """Try candidates in order until one succeeds (fallback/src/lib.rs
+    `Fallback::first_success`)."""
+
+    def __init__(self, candidates):
+        self.candidates = list(candidates)
+
+    def first_success(self, fn, *args, exceptions=(Exception,), **kwargs):
+        errors = []
+        for candidate in self.candidates:
+            try:
+                return fn(candidate, *args, **kwargs)
+            except exceptions as e:  # noqa: PERF203 — ordered failover
+                errors.append((repr(candidate), e))
+        raise FallbackError(errors)
+
+    def map_format_error(self) -> str:
+        return ", ".join(repr(c) for c in self.candidates)
+
+
+class HashSetDelay:
+    """Set with per-entry expiry (hashset_delay/src/lib.rs). Insertion
+    (re)arms the entry's timer; ``prune`` pops expired keys."""
+
+    def __init__(self, default_timeout: float):
+        self.default_timeout = default_timeout
+        self._expiries: "OrderedDict[object, float]" = OrderedDict()
+
+    def _now(self, now: float | None) -> float:
+        return time.monotonic() if now is None else now
+
+    def insert(self, key, timeout: float | None = None,
+               now: float | None = None) -> None:
+        self._expiries.pop(key, None)
+        self._expiries[key] = self._now(now) + (
+            self.default_timeout if timeout is None else timeout
+        )
+
+    def contains(self, key, now: float | None = None) -> bool:
+        expiry = self._expiries.get(key)
+        return expiry is not None and self._now(now) < expiry
+
+    def remove(self, key) -> bool:
+        return self._expiries.pop(key, None) is not None
+
+    def prune(self, now: float | None = None) -> list:
+        """Pop and return all expired keys (the poll_next drain)."""
+        t = self._now(now)
+        expired = [k for k, exp in self._expiries.items() if exp <= t]
+        for k in expired:
+            del self._expiries[k]
+        return expired
+
+    def __len__(self) -> int:
+        return len(self._expiries)
+
+    def keys(self) -> list:
+        return list(self._expiries)
+
+
+class LRUTimeCache:
+    """Bounded "seen recently" cache: membership lapses after ``ttl``
+    seconds or when capacity evicts the oldest (lru_cache/time_cache.rs)."""
+
+    def __init__(self, ttl: float, capacity: int = 65536):
+        self.ttl = ttl
+        self.capacity = capacity
+        self._seen: "OrderedDict[object, float]" = OrderedDict()
+
+    def _now(self, now: float | None) -> float:
+        return time.monotonic() if now is None else now
+
+    def insert(self, key, now: float | None = None) -> bool:
+        """Insert; returns True if the key was NOT already fresh (i.e.
+        first sighting within the ttl window)."""
+        t = self._now(now)
+        fresh = self.contains(key, now=t)
+        self._seen.pop(key, None)
+        self._seen[key] = t
+        while len(self._seen) > self.capacity:
+            self._seen.popitem(last=False)
+        return not fresh
+
+    def contains(self, key, now: float | None = None) -> bool:
+        born = self._seen.get(key)
+        return born is not None and self._now(now) - born < self.ttl
+
+    def prune(self, now: float | None = None) -> int:
+        t = self._now(now)
+        stale = [k for k, born in self._seen.items() if t - born >= self.ttl]
+        for k in stale:
+            del self._seen[k]
+        return len(stale)
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+
+class LockfileError(Exception):
+    pass
+
+
+class Lockfile:
+    """Advisory pidfile lock (lockfile/src/lib.rs): refuses to acquire
+    when the file exists and its pid is alive; stale files (dead pid)
+    are reclaimed."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._held = False
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+        return True
+
+    def acquire(self) -> "Lockfile":
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    pid = int(f.read().strip() or "0")
+            except (OSError, ValueError):
+                pid = 0
+            if pid and self._pid_alive(pid) and pid != os.getpid():
+                raise LockfileError(
+                    f"{self.path} is locked by live process {pid}"
+                )
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "w") as f:
+            f.write(str(os.getpid()))
+        self._held = True
+        return self
+
+    def release(self) -> None:
+        if self._held:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+            self._held = False
+
+    def __enter__(self) -> "Lockfile":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SensitiveUrl:
+    """URL whose string form redacts everything but scheme+host
+    (sensitive_url/src/lib.rs — engine/eth1 endpoints carry JWT/basic
+    auth and must never reach logs in full)."""
+
+    def __init__(self, url: str):
+        parsed = urlparse(url)
+        if not parsed.scheme or not parsed.netloc:
+            raise ValueError(f"invalid url: {url!r}")
+        self.full = url
+        self._parsed = parsed
+
+    @property
+    def redacted(self) -> str:
+        host = self._parsed.hostname or ""
+        if self._parsed.port:
+            host += f":{self._parsed.port}"
+        return urlunparse((self._parsed.scheme, host, "", "", "", ""))
+
+    def __str__(self) -> str:
+        return self.redacted
+
+    def __repr__(self) -> str:
+        return f"SensitiveUrl({self.redacted})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SensitiveUrl) and other.full == self.full
+
+    def __hash__(self) -> int:
+        return hash(self.full)

@@ -33,6 +33,47 @@ def hash32_concat(a: bytes, b: bytes) -> bytes:
     return h.digest()
 
 
+_NATIVE = None  # lazily-resolved lhsha library (False = unavailable)
+
+# Below this many sibling pairs the per-call FFI overhead beats the win.
+NATIVE_LAYER_THRESHOLD = 32
+
+
+def _native():
+    global _NATIVE
+    if _NATIVE is None:
+        try:
+            from ..native import load_lhsha
+
+            _NATIVE = load_lhsha() or False
+        except Exception:
+            _NATIVE = False
+    return _NATIVE
+
+
+def hash_merkle_layer(pairs: bytes) -> bytes:
+    """Hash one merkle layer: ``len(pairs)//64`` independent 64-byte
+    sibling pairs → concatenated 32-byte parents.
+
+    Dispatches to the native lhsha batch kernel (sha256.cpp: two
+    compressions per pair with a precomputed padding block, SHA-NI,
+    threads at scale — the eth2_hashing-style native path of SURVEY
+    §2.6 item 2) and falls back to hashlib.
+    """
+    n = len(pairs) // 64
+    if n == 0:
+        return b""
+    lib = _native() if n >= NATIVE_LAYER_THRESHOLD else None
+    if lib:
+        import ctypes
+
+        out = ctypes.create_string_buffer(32 * n)
+        lib.lhsha_merkle_layer(pairs, n, out, 0)
+        return out.raw
+    sha = hashlib.sha256
+    return b"".join(sha(pairs[64 * i:64 * (i + 1)]).digest() for i in range(n))
+
+
 def _build_zero_hashes() -> list[bytes]:
     out = [b"\x00" * HASH_LEN]
     for _ in range(ZERO_HASHES_MAX_INDEX):

@@ -18,7 +18,7 @@ ZERO_HASHES cache), mix in length for lists/bitlists.
 
 from __future__ import annotations
 
-from .hashing import ZERO_HASHES, hash32_concat
+from .hashing import ZERO_HASHES, hash32_concat, hash_merkle_layer
 
 BYTES_PER_CHUNK = 32
 OFFSET_LEN = 4
@@ -51,13 +51,21 @@ def merkleize_chunks(chunks: list[bytes], limit: int | None = None) -> bytes:
 
     layer = list(chunks)
     for d in range(depth):
-        nxt = []
-        odd = len(layer) & 1
-        for i in range(0, len(layer) - odd, 2):
-            nxt.append(hash32_concat(layer[i], layer[i + 1]))
-        if odd:
-            nxt.append(hash32_concat(layer[-1], ZERO_HASHES[d]))
-        layer = nxt or [ZERO_HASHES[d + 1]]
+        if not layer:
+            layer = [ZERO_HASHES[d + 1]]
+            continue
+        if len(layer) & 1:
+            layer = layer + [ZERO_HASHES[d]]
+        if len(layer) >= 64:
+            # wide layer: one native batch call (hash_merkle_layer →
+            # lhsha SHA-NI/threaded kernel) instead of len/2 Python hashes
+            parents = hash_merkle_layer(b"".join(layer))
+            layer = [parents[i:i + 32] for i in range(0, len(parents), 32)]
+        else:
+            layer = [
+                hash32_concat(layer[i], layer[i + 1])
+                for i in range(0, len(layer), 2)
+            ]
     return layer[0] if layer else ZERO_HASHES[depth]
 
 

@@ -14,19 +14,26 @@ LoC + beacon_node/eth1 3.4k LoC + builder_client).
 * ``eth1``             — deposit-contract follower: BlockCache +
   DepositCache (incremental deposit Merkle tree) + eth1-data voting
   (eth1/src/service.rs:497).
+* ``builder``          — external block-builder client + blinded-block
+  flow + mock builder (builder_client/src/lib.rs,
+  test_utils/mock_builder.rs).
 """
 
+from .builder import BuilderError, BuilderHttpClient, MockBuilder
 from .engine_api import EngineApiClient, JwtAuth, PayloadStatus
 from .eth1 import Eth1Service
 from .execution_layer import ExecutionLayer
 from .mock import ExecutionBlockGenerator, MockExecutionServer
 
 __all__ = [
+    "BuilderError",
+    "BuilderHttpClient",
     "EngineApiClient",
     "Eth1Service",
     "ExecutionBlockGenerator",
     "ExecutionLayer",
     "JwtAuth",
+    "MockBuilder",
     "MockExecutionServer",
     "PayloadStatus",
 ]

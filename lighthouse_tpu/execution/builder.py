@@ -1,0 +1,214 @@
+"""External block-builder client (mev-boost style) + mock builder.
+
+Capability mirror of `beacon_node/builder_client/src/lib.rs`
+(BuilderHttpClient: post_builder_validators:119,
+post_builder_blinded_blocks:137, get_builder_header:158,
+get_builder_status:180) and the blinded-payload proposal flow in
+`consensus/types/src/payload.rs` / `execution_layer/src/lib.rs`:
+
+1. validators register fee-recipient/gas-limit preferences
+   (``POST /eth/v1/builder/validators``),
+2. at proposal time the BN fetches a header-only bid
+   (``GET /eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}``),
+3. the proposer signs a *blinded* block carrying just the payload
+   header, submits it (``POST /eth/v1/builder/blinded_blocks``), and the
+   builder reveals the full ExecutionPayload.
+
+``MockBuilder`` is the in-process builder used by tests (the
+`execution_layer/src/test_utils/mock_builder.rs` equivalent), driving an
+``ExecutionBlockGenerator`` to build real (mock-chain) payloads and
+serving the three endpoints over HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+from ..common.support import HttpServerLifecycle, JsonHttpHandler
+from ..consensus.hashing import hash_bytes
+
+
+class BuilderError(Exception):
+    pass
+
+
+def header_json_from_payload_json(payload: dict) -> dict:
+    """Full engine-API payload JSON → header JSON: transactions list
+    replaced by its merkle-style commitment (payload.rs
+    ExecutionPayloadHeader::from)."""
+    header = {k: v for k, v in payload.items() if k != "transactions"}
+    txs = payload.get("transactions", [])
+    leaves = b"".join(
+        hash_bytes(bytes.fromhex(t.removeprefix("0x"))) for t in txs
+    )
+    header["transactionsRoot"] = "0x" + hash_bytes(
+        len(txs).to_bytes(8, "little") + leaves
+    ).hex()
+    return header
+
+
+class BuilderHttpClient:
+    """Typed client for the builder API (builder_client/src/lib.rs)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data,
+            headers={"Content-Type": "application/json"}, method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            raise BuilderError(f"builder HTTP {e.code} on {path}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise BuilderError(f"builder unreachable: {e}") from e
+
+    # ----------------------------------------------------------- endpoints
+    def register_validators(self, registrations: list[dict]) -> None:
+        """POST /eth/v1/builder/validators — signed fee-recipient /
+        gas-limit preferences (post_builder_validators:119)."""
+        self._request("POST", "/eth/v1/builder/validators", registrations)
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes) -> dict:
+        """GET /eth/v1/builder/header/... → signed builder bid
+        {header, value, pubkey} (get_builder_header:158)."""
+        path = (
+            f"/eth/v1/builder/header/{slot}/0x{bytes(parent_hash).hex()}"
+            f"/0x{bytes(pubkey).hex()}"
+        )
+        out = self._request("GET", path)
+        return out["data"]["message"]
+
+    def submit_blinded_block(self, signed_blinded_block: dict) -> dict:
+        """POST /eth/v1/builder/blinded_blocks → the unblinded full
+        payload JSON (post_builder_blinded_blocks:137)."""
+        out = self._request(
+            "POST", "/eth/v1/builder/blinded_blocks", signed_blinded_block
+        )
+        return out["data"]
+
+    def status(self) -> bool:
+        """GET /eth/v1/builder/status (get_builder_status:180)."""
+        try:
+            self._request("GET", "/eth/v1/builder/status")
+            return True
+        except BuilderError:
+            return False
+
+
+class MockBuilder(HttpServerLifecycle):
+    """In-process builder server over an ExecutionBlockGenerator
+    (test_utils/mock_builder.rs): builds a payload per header request,
+    quotes a bid, and reveals the payload on blinded-block submission.
+    ``missing_payloads=True`` simulates a withholding builder (the
+    failure tests' adversarial case)."""
+
+    def __init__(self, generator, host: str = "127.0.0.1", port: int = 0,
+                 payload_value_wei: int = 1_000_000_000):
+        self.generator = generator
+        self.registrations: dict[bytes, dict] = {}
+        self.payloads_by_header_hash: dict[str, dict] = {}
+        self.payload_value_wei = payload_value_wei
+        self.missing_payloads = False
+        server = self
+
+        class Handler(JsonHttpHandler, BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/eth/v1/builder/status":
+                    self.send_json(200, {})
+                    return
+                if self.path.startswith("/eth/v1/builder/header/"):
+                    parts = self.path.split("/")
+                    try:
+                        slot = int(parts[5])
+                        parent_hash = bytes.fromhex(parts[6].removeprefix("0x"))
+                        pubkey = bytes.fromhex(parts[7].removeprefix("0x"))
+                    except (IndexError, ValueError):
+                        self.send_error(400)
+                        return
+                    bid = server._build_bid(slot, parent_hash, pubkey)
+                    if bid is None:
+                        self.send_error(404, "unknown parent")
+                        return
+                    self.send_json(200, {"version": "bellatrix",
+                                         "data": {"message": bid,
+                                                  "signature": "0x" + "00" * 96}})
+                    return
+                self.send_error(404)
+
+            def do_POST(self):
+                try:
+                    body = self.read_json()
+                except ValueError:
+                    self.send_error(400)
+                    return
+                if self.path == "/eth/v1/builder/validators":
+                    for reg in body or []:
+                        msg = reg.get("message", reg)
+                        pk = bytes.fromhex(
+                            msg["pubkey"].removeprefix("0x")
+                        )
+                        server.registrations[pk] = msg
+                    self.send_json(200, {})
+                    return
+                if self.path == "/eth/v1/builder/blinded_blocks":
+                    payload = server._reveal(body)
+                    if payload is None:
+                        self.send_error(400, "unknown or withheld payload")
+                        return
+                    self.send_json(200, {"version": "bellatrix",
+                                         "data": payload})
+                    return
+                self.send_error(404)
+
+        self._init_http(Handler, host, port)
+
+    # ------------------------------------------------------------ behavior
+    def _build_bid(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        reg = self.registrations.get(pubkey, {})
+        attributes = {
+            "timestamp": hex(slot * 12),
+            "prevRandao": "0x" + "00" * 32,
+            "suggestedFeeRecipient": reg.get(
+                "fee_recipient", "0x" + "00" * 20
+            ),
+        }
+        try:
+            payload = self.generator._build_payload(
+                bytes(parent_hash), attributes
+            )
+        except KeyError:
+            return None  # unknown parent → 404 at the endpoint
+        if "gas_limit" in reg:
+            payload["gasLimit"] = hex(int(reg["gas_limit"]))
+            payload["blockHash"] = (
+                "0x" + self.generator.compute_block_hash(payload).hex()
+            )
+        header = header_json_from_payload_json(payload)
+        self.payloads_by_header_hash[payload["blockHash"]] = payload
+        return {
+            "header": header,
+            "value": str(self.payload_value_wei),
+            "pubkey": "0x" + "aa" * 48,
+        }
+
+    def _reveal(self, signed_blinded_block: dict):
+        if self.missing_payloads:
+            return None
+        try:
+            block_hash = (
+                signed_blinded_block["message"]["body"]
+                ["execution_payload_header"]["blockHash"]
+            )
+        except (KeyError, TypeError):
+            return None
+        return self.payloads_by_header_hash.get(block_hash)

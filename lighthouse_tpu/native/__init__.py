@@ -18,22 +18,25 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _LIB = None
+_SHA_LIB = None
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def _build_lib() -> str:
-    src = os.path.join(_DIR, "kvstore.cpp")
+def _compile(src_name: str, stem: str, extra_flags: tuple = ()) -> str:
+    """Build `src_name` into a content-hash-keyed shared library."""
+    src = os.path.join(_DIR, src_name)
     with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_DIR, f"liblhkv-{digest}.so")
+    out = os.path.join(_DIR, f"lib{stem}-{digest}.so")
     if os.path.exists(out):
         return out
     tmp = out + ".tmp"
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        *extra_flags, "-o", tmp, src,
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -41,12 +44,41 @@ def _build_lib() -> str:
     os.replace(tmp, out)
     # Drop stale builds.
     for name in os.listdir(_DIR):
-        if name.startswith("liblhkv-") and name.endswith(".so") and name != os.path.basename(out):
+        if (name.startswith(f"lib{stem}-") and name.endswith(".so")
+                and name != os.path.basename(out)):
             try:
                 os.unlink(os.path.join(_DIR, name))
             except OSError:
                 pass
     return out
+
+
+def _build_lib() -> str:
+    return _compile("kvstore.cpp", "lhkv")
+
+
+def load_lhsha():
+    """Native SHA-256 (sha256.cpp): one-shot hash + threaded fixed-64B
+    merkle-layer batch, SHA-NI dispatched. Returns None when the
+    toolchain is unavailable (callers fall back to hashlib)."""
+    global _SHA_LIB
+    with _LOCK:
+        if _SHA_LIB is None:
+            try:
+                lib = ctypes.CDLL(_compile("sha256.cpp", "lhsha", ("-pthread",)))
+            except (NativeBuildError, OSError):
+                _SHA_LIB = False
+                return None
+            lib.lhsha_hash.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            lib.lhsha_merkle_layer.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.lhsha_has_shani.restype = ctypes.c_int
+            _SHA_LIB = lib
+    return _SHA_LIB or None
 
 
 def load_lhkv() -> ctypes.CDLL:
@@ -98,6 +130,12 @@ def load_lhkv() -> ctypes.CDLL:
                 ctypes.c_void_p,
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                 ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            lib.lhkv_iter_next_key.restype = ctypes.c_int
+            lib.lhkv_iter_next_key.argtypes = [
+                ctypes.c_void_p,
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                 ctypes.POINTER(ctypes.c_size_t),
             ]

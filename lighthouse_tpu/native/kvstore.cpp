@@ -411,6 +411,19 @@ int lhkv_iter_next(void* hi, uint8_t** key, size_t* klen, uint8_t** val,
   return 0;
 }
 
+// Key-only variant: no value pread — counting/key scans skip the disk
+// read entirely. 0 = item produced; 1 = exhausted.
+int lhkv_iter_next_key(void* hi, uint8_t** key, size_t* klen) {
+  Iter* it = (Iter*)hi;
+  if (it->pos >= it->items.size()) return 1;
+  auto& kv = it->items[it->pos++];
+  uint8_t* k = (uint8_t*)malloc(kv.first.size() ? kv.first.size() : 1);
+  memcpy(k, kv.first.data(), kv.first.size());
+  *key = k;
+  *klen = kv.first.size();
+  return 0;
+}
+
 void lhkv_iter_close(void* hi) {
   Iter* it = (Iter*)hi;
   {

@@ -1,0 +1,254 @@
+// lhsha — native SHA-256 for the consensus hashing hot path.
+//
+// Capability mirror of the reference's native hashing layer
+// (crypto/eth2_hashing: sha2 w/ SHA-NI intrinsics, ring fallback —
+// SURVEY §2.6 item 2). Two entry points:
+//
+//   lhsha_hash(data, len, out)            — one-shot digest.
+//   lhsha_merkle_layer(in, n, out, thr)   — n independent 64-byte
+//       messages (merkle sibling pairs) → n 32-byte digests. The
+//       padding block for a 64-byte message is constant, so each digest
+//       is exactly two compressions with a precomputed second block;
+//       large layers fan out across threads. This is the tree-hash
+//       inner loop (cached_tree_hash/ssz merkleize at state scale).
+//
+// Implementation dispatches at first use between the SHA-NI
+// instruction path (x86 sha extensions) and a portable scalar
+// compressor (FIPS 180-4).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t IV[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t load_be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+// ------------------------------------------------------------- scalar path
+void compress_scalar(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) w[i] = load_be32(block + 4 * i);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// ------------------------------------------------------------- SHA-NI path
+#if defined(__x86_64__)
+__attribute__((target("sha,sse4.1")))
+void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+
+  // state: abef/cdgh register layout used by the sha256rnds2 instruction
+  __m128i tmp = _mm_loadu_si128((const __m128i*)&state[0]);   // dcba
+  __m128i st1 = _mm_loadu_si128((const __m128i*)&state[4]);   // hgfe
+  tmp = _mm_shuffle_epi32(tmp, 0xB1);                         // cdab
+  st1 = _mm_shuffle_epi32(st1, 0x1B);                         // efgh
+  __m128i abef = _mm_alignr_epi8(tmp, st1, 8);                // abef
+  __m128i cdgh = _mm_blend_epi16(st1, tmp, 0xF0);             // cdgh
+  const __m128i abef_save = abef, cdgh_save = cdgh;
+
+  __m128i msg, msg0, msg1, msg2, msg3;
+
+  msg0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 0)), MASK);
+  msg = _mm_add_epi32(msg0, _mm_loadu_si128((const __m128i*)&K[0]));
+  cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+  msg = _mm_shuffle_epi32(msg, 0x0E);
+  abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+
+  msg1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 16)), MASK);
+  msg = _mm_add_epi32(msg1, _mm_loadu_si128((const __m128i*)&K[4]));
+  cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+  msg = _mm_shuffle_epi32(msg, 0x0E);
+  abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+  msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+
+  msg2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 32)), MASK);
+  msg = _mm_add_epi32(msg2, _mm_loadu_si128((const __m128i*)&K[8]));
+  cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+  msg = _mm_shuffle_epi32(msg, 0x0E);
+  abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+  msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+
+  msg3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 48)), MASK);
+  msg = _mm_add_epi32(msg3, _mm_loadu_si128((const __m128i*)&K[12]));
+  cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+  msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+  msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+  msg = _mm_shuffle_epi32(msg, 0x0E);
+  abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+  msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+
+  for (int i = 16; i < 64; i += 16) {
+    msg = _mm_add_epi32(msg0, _mm_loadu_si128((const __m128i*)&K[i]));
+    cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+    msg1 = _mm_add_epi32(msg1, _mm_alignr_epi8(msg0, msg3, 4));
+    msg1 = _mm_sha256msg2_epu32(msg1, msg0);
+    msg = _mm_shuffle_epi32(msg, 0x0E);
+    abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+    msg3 = _mm_sha256msg1_epu32(msg3, msg0);
+
+    msg = _mm_add_epi32(msg1, _mm_loadu_si128((const __m128i*)&K[i + 4]));
+    cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+    msg2 = _mm_add_epi32(msg2, _mm_alignr_epi8(msg1, msg0, 4));
+    msg2 = _mm_sha256msg2_epu32(msg2, msg1);
+    msg = _mm_shuffle_epi32(msg, 0x0E);
+    abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+    msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+
+    msg = _mm_add_epi32(msg2, _mm_loadu_si128((const __m128i*)&K[i + 8]));
+    cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+    msg3 = _mm_add_epi32(msg3, _mm_alignr_epi8(msg2, msg1, 4));
+    msg3 = _mm_sha256msg2_epu32(msg3, msg2);
+    msg = _mm_shuffle_epi32(msg, 0x0E);
+    abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+    msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+
+    msg = _mm_add_epi32(msg3, _mm_loadu_si128((const __m128i*)&K[i + 12]));
+    cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);
+    msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+    msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+    msg = _mm_shuffle_epi32(msg, 0x0E);
+    abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+    msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+  }
+
+  abef = _mm_add_epi32(abef, abef_save);
+  cdgh = _mm_add_epi32(cdgh, cdgh_save);
+
+  tmp = _mm_shuffle_epi32(abef, 0x1B);                        // feba
+  st1 = _mm_shuffle_epi32(cdgh, 0xB1);                        // dchg
+  _mm_storeu_si128((__m128i*)&state[0], _mm_blend_epi16(tmp, st1, 0xF0));
+  _mm_storeu_si128((__m128i*)&state[4], _mm_alignr_epi8(st1, tmp, 8));
+}
+#endif
+
+using CompressFn = void (*)(uint32_t[8], const uint8_t[64]);
+
+CompressFn pick_compress() {
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("sha")) return compress_shani;
+#endif
+  return compress_scalar;
+}
+
+CompressFn g_compress = pick_compress();
+
+// Constant second block for a 64-byte message: 0x80 pad + bit length 512.
+const uint8_t PAD_BLOCK_64[64] = {
+    0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 2, 0};
+
+void digest64(const uint8_t* msg, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, IV, sizeof(st));
+  g_compress(st, msg);
+  g_compress(st, PAD_BLOCK_64);
+  for (int i = 0; i < 8; i++) store_be32(out + 4 * i, st[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+int lhsha_has_shani() {
+#if defined(__x86_64__)
+  return __builtin_cpu_supports("sha") ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+void lhsha_hash(const uint8_t* data, size_t len, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, IV, sizeof(st));
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; i++) g_compress(st, data + 64 * i);
+  uint8_t tail[128] = {0};
+  size_t rem = len - full * 64;
+  std::memcpy(tail, data + full * 64, rem);
+  tail[rem] = 0x80;
+  size_t tail_blocks = (rem + 1 + 8 <= 64) ? 1 : 2;
+  uint64_t bits = uint64_t(len) * 8;
+  uint8_t* lenp = tail + tail_blocks * 64 - 8;
+  for (int i = 0; i < 8; i++) lenp[i] = uint8_t(bits >> (56 - 8 * i));
+  for (size_t i = 0; i < tail_blocks; i++) g_compress(st, tail + 64 * i);
+  for (int i = 0; i < 8; i++) store_be32(out + 4 * i, st[i]);
+}
+
+// n independent 64-byte messages -> n 32-byte digests.
+void lhsha_merkle_layer(const uint8_t* in, size_t n, uint8_t* out,
+                        int n_threads) {
+  if (n == 0) return;
+  size_t min_per_thread = 2048;  // FFI + spawn cost floor
+  size_t want = n / min_per_thread;
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t threads = want < 2 ? 1 : (want > hw ? hw : want);
+  if (n_threads > 0 && size_t(n_threads) < threads) threads = n_threads;
+  if (threads <= 1) {
+    for (size_t i = 0; i < n; i++) digest64(in + 64 * i, out + 32 * i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  size_t chunk = (n + threads - 1) / threads;
+  for (size_t t = 0; t < threads; t++) {
+    size_t lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (size_t i = lo; i < hi; i++) digest64(in + 64 * i, out + 32 * i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

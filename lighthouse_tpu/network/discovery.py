@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..common.support import HttpServerLifecycle, JsonHttpHandler
+
 
 @dataclass
 class Enr:
@@ -93,3 +95,92 @@ class BootNode:
 
     def known_peers(self) -> list[str]:
         return [n for n in self.discovery.hub.enr_registry if n != self.enr.node_id]
+
+
+# ------------------------------------------------------- standalone bootnode
+def _enr_to_json(enr: Enr) -> dict:
+    return {
+        "node_id": enr.node_id,
+        "fork_digest": enr.fork_digest.hex(),
+        "attnets": enr.attnets,
+        "syncnets": enr.syncnets,
+        "seq": enr.seq,
+    }
+
+
+def _enr_from_json(d: dict) -> Enr:
+    return Enr(
+        node_id=d["node_id"],
+        fork_digest=bytes.fromhex(d["fork_digest"]),
+        attnets=int(d["attnets"]),
+        syncnets=int(d["syncnets"]),
+        seq=int(d["seq"]),
+    )
+
+
+class BootNodeServer(HttpServerLifecycle):
+    """Standalone cross-process bootnode (the `boot_node` binary,
+    `boot_node/src/`): an ENR registry served over HTTP — the in-image
+    stand-in for discv5 UDP. Nodes POST their record and GET the set of
+    known peers; records only ever move forward by `seq` (ENR update
+    semantics)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler
+
+        self.registry: dict[str, Enr] = {}
+        server = self
+
+        class Handler(JsonHttpHandler, BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") == "/enr":
+                    self.send_json(200, [
+                        _enr_to_json(e) for e in server.registry.values()
+                    ])
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/enr":
+                    self.send_error(404)
+                    return
+                try:
+                    enr = _enr_from_json(self.read_json())
+                except (ValueError, KeyError, TypeError):
+                    self.send_error(400)
+                    return
+                prev = server.registry.get(enr.node_id)
+                if prev is None or enr.seq >= prev.seq:
+                    server.registry[enr.node_id] = enr
+                self.send_json(200, {"known": len(server.registry)})
+
+        self._init_http(Handler, host, port)
+
+
+def sync_with_boot_node(discovery: Discovery, url: str,
+                        timeout: float = 5.0) -> int:
+    """One discovery round against a remote bootnode: publish our ENR,
+    pull the registry into the local hub directory. Returns new records
+    learned (the dial-candidate count)."""
+    import json
+    import urllib.request
+
+    body = json.dumps(_enr_to_json(discovery.local)).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/enr", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass
+    with urllib.request.urlopen(url.rstrip("/") + "/enr", timeout=timeout) as resp:
+        records = json.loads(resp.read())
+    learned = 0
+    for d in records:
+        enr = _enr_from_json(d)
+        if enr.node_id == discovery.local.node_id:
+            continue
+        prev = discovery.hub.enr_registry.get(enr.node_id)
+        if prev is None or enr.seq > prev.seq:
+            discovery.hub.enr_registry[enr.node_id] = enr
+            learned += 1
+    return learned

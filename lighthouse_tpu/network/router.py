@@ -17,6 +17,9 @@ from ..consensus.verify_operation import OperationError
 from . import gossip as g
 from .peer_manager import PeerAction
 from .processor import BeaconProcessor, WorkEvent, WorkType
+from .work_reprocessing import ReprocessQueue
+
+_UNKNOWN_BLOCK_ERRORS = ("unknown head block", "unknown target block")
 
 _KIND_TO_WORK = {
     g.BEACON_BLOCK: WorkType.GOSSIP_BLOCK,
@@ -36,6 +39,7 @@ class Router:
         self.peer_manager = peer_manager
         self.publish = publish  # fn(kind, item) -> None (service re-publish)
         self.sync = sync_manager
+        self.reprocess = ReprocessQueue(processor)
         self.stats = {
             "attestations_verified": 0,
             "attestations_rejected": 0,
@@ -89,6 +93,16 @@ class Router:
         )
         for ev, res in zip(events, results):
             if isinstance(res, Exception):
+                if str(res) in _UNKNOWN_BLOCK_ERRORS:
+                    # the block is probably milliseconds behind on gossip:
+                    # park for reprocessing, no peer penalty
+                    # (work_reprocessing_queue.rs)
+                    self.reprocess.queue_unknown_block_attestation(
+                        ev,
+                        bytes(ev.payload.data.beacon_block_root),
+                        self.chain.current_slot(),
+                    )
+                    continue
                 self.stats["attestations_rejected"] += 1
                 if ev.peer_id is not None:
                     self.peer_manager.report_peer(
@@ -108,7 +122,16 @@ class Router:
                 verified = self.chain.verify_aggregated_attestation_for_gossip(
                     ev.payload
                 )
-            except (AttestationError, ValueError):
+            except (AttestationError, ValueError) as e:
+                if str(e) in _UNKNOWN_BLOCK_ERRORS:
+                    self.reprocess.queue_unknown_block_attestation(
+                        ev,
+                        bytes(
+                            ev.payload.message.aggregate.data.beacon_block_root
+                        ),
+                        self.chain.current_slot(),
+                    )
+                    continue
                 self.stats["attestations_rejected"] += 1
                 if ev.peer_id is not None:
                     self.peer_manager.report_peer(
@@ -128,11 +151,27 @@ class Router:
             if "unknown parent" in str(e) and self.sync is not None:
                 self.sync.on_unknown_parent(ev.payload, ev.peer_id)
                 return
+            if str(e) == "block from the future":
+                # clock skew: hold until the slot starts
+                # (work_reprocessing_queue.rs QueuedGossipBlock);
+                # too-far-future or queue-full → treated as a bad block
+                held = self.reprocess.queue_early_block(
+                    ev, int(ev.payload.message.slot),
+                    self.chain.current_slot(),
+                )
+                if not held:
+                    self.stats["blocks_rejected"] += 1
+                    if ev.peer_id is not None:
+                        self.peer_manager.report_peer(
+                            ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
+                        )
+                return
             self.stats["blocks_rejected"] += 1
             if ev.peer_id is not None:
                 self.peer_manager.report_peer(ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR)
             return
         self.stats["blocks_imported"] += 1
+        self.reprocess.on_block_imported(ev.payload.message.hash_tree_root())
         if ev.peer_id is not None:
             self.peer_manager.report_peer(ev.peer_id, PeerAction.VALUABLE_MESSAGE)
         if republish and self.publish is not None:

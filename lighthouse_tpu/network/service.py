@@ -66,6 +66,18 @@ class NetworkService:
                 syncnets=(1 << g.SYNC_COMMITTEE_SUBNET_COUNT) - 1,
             ),
         )
+        from .subnet_service import (
+            AttestationSubnetService,
+            SyncCommitteeSubnetService,
+        )
+
+        self.attestation_subnets = AttestationSubnetService(
+            chain.spec, node_id=node_id,
+            subscribe_all_subnets=subscribe_all_subnets,
+        )
+        self.sync_subnets = SyncCommitteeSubnetService(
+            chain.spec, subscribe_all_subnets=subscribe_all_subnets
+        )
         self._subscribe_topics(subscribe_all_subnets)
         self._register_rpc()
         self.peer.on_gossip = self._on_gossip
@@ -81,6 +93,59 @@ class NetworkService:
             if self.send_status(enr.node_id) is not None:
                 connected += 1
         return connected
+
+    # ---------------------------------------------------------- subnet mgmt
+    def process_attester_subscriptions(self, subscriptions) -> None:
+        """Duty registrations from the validator client / HTTP API
+        (POST validator/beacon_committee_subscriptions → subnet_service)."""
+        slot = self.chain.current_slot()
+        self._apply_subnet_messages(
+            self.attestation_subnets.validator_subscriptions(subscriptions, slot)
+        )
+
+    def process_sync_subscriptions(self, subscriptions) -> None:
+        slot = self.chain.current_slot()
+        self._apply_subnet_messages(
+            self.sync_subnets.validator_subscriptions(subscriptions, slot)
+        )
+
+    def subnet_tick(self) -> None:
+        """Per-slot maintenance: expire duty subscriptions, rotate random
+        subnets (the reference's HashSetDelay wakeups, slot-driven here)."""
+        slot = self.chain.current_slot()
+        self._apply_subnet_messages(self.attestation_subnets.tick(slot))
+        self._apply_subnet_messages(self.sync_subnets.tick(slot))
+
+    def _apply_subnet_messages(self, msgs) -> None:
+        """Apply SubnetServiceMessage actions to the swarm + ENR
+        (network/src/service.rs handling of SubnetServiceMessage)."""
+        for m in msgs:
+            if m.kind == "attestation":
+                topic = g.GossipTopic.attestation_subnet(self.fork_digest, m.subnet_id)
+            else:
+                topic = g.GossipTopic.sync_subnet(self.fork_digest, m.subnet_id)
+            if m.action == "subscribe":
+                self.peer.subscribe(str(topic))
+            elif m.action == "unsubscribe":
+                self.peer.unsubscribe(str(topic))
+            elif m.action in ("enr_add", "enr_remove"):
+                if m.kind == "attestation":
+                    self.discovery.update_local(
+                        attnets=self.attestation_subnets.enr_bitfield()
+                    )
+                else:
+                    self.discovery.update_local(
+                        syncnets=self.sync_subnets.enr_bitfield()
+                    )
+            elif m.action == "discover_peers":
+                finder = (
+                    self.discovery.peers_on_attnet
+                    if m.kind == "attestation"
+                    else self.discovery.peers_on_syncnet
+                )
+                for enr in finder(m.subnet_id):
+                    if not self.peer_manager.is_connected(enr.node_id):
+                        self.send_status(enr.node_id)
 
     # --------------------------------------------------------------- topics
     def _subscribe_topics(self, all_subnets: bool) -> None:
@@ -257,7 +322,9 @@ class NetworkService:
         return remote
 
     def poll(self) -> int:
-        """One event-loop turn: deliver queued gossip, then drain the
-        processor. Returns events processed."""
+        """One event-loop turn: deliver queued gossip, release/expire
+        reprocess-queue work, then drain the processor. Returns events
+        processed."""
         self.peer.deliver_pending()
+        self.router.reprocess.tick(self.chain.current_slot())
         return self.processor.process_pending()

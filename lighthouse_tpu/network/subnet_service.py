@@ -1,0 +1,315 @@
+"""Attestation / sync-committee subnet subscription management.
+
+Capability mirror of `network/src/subnet_service/` in the reference
+(`mod.rs` SubnetServiceMessage; `attestation_subnets.rs` AttestationService
+— duty-driven short-lived subscriptions, long-lived random subnets with
+ENR advertisement, peer-discovery requests; `sync_subnets.rs`
+SyncCommitteeService — period-long subscriptions).
+
+Where the reference is tokio-timer driven (HashSetDelay expirations waking
+a Stream), this implementation is deterministically slot-driven: callers
+feed duty subscriptions via ``validator_subscriptions(...)`` and advance
+time via ``tick(current_slot)``; both return the resulting
+``SubnetMessage`` actions (subscribe / unsubscribe / enr_add / enr_remove /
+discover_peers) for the network service to apply. That keeps the whole
+subnet lifecycle testable without wall-clock time, matching the repo-wide
+ManualSlotClock style.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from . import gossip as g
+
+# attestation_subnets.rs:27-37
+MIN_PEER_DISCOVERY_SLOT_LOOK_AHEAD = 2
+LAST_SEEN_VALIDATOR_TIMEOUT_EPOCHS = 150
+ADVANCE_SUBSCRIBE_SLOTS = 3
+# spec values carried by ChainSpec in the reference (chain_spec.rs)
+RANDOM_SUBNETS_PER_VALIDATOR = 1
+EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION = 256
+
+
+@dataclass(frozen=True)
+class ValidatorSubscription:
+    """One attester duty registration (validator_subscription.rs)."""
+
+    validator_index: int
+    committee_index: int
+    slot: int
+    committee_count_at_slot: int
+    is_aggregator: bool
+
+
+@dataclass(frozen=True)
+class SyncCommitteeSubscription:
+    """Sync-duty registration: validator's positions in the current
+    committee and the last epoch the subscription is valid for."""
+
+    validator_index: int
+    sync_committee_indices: tuple
+    until_epoch: int
+
+
+@dataclass(frozen=True)
+class SubnetMessage:
+    """SubnetServiceMessage (subnet_service/mod.rs:13-24)."""
+
+    action: str          # subscribe|unsubscribe|enr_add|enr_remove|discover_peers
+    kind: str            # "attestation" | "sync"
+    subnet_id: int
+    min_ttl_slot: int | None = None   # discover_peers: keep peers until this slot
+
+
+@dataclass
+class _ShortLived:
+    subnet_id: int
+    slot: int            # the duty slot; unsubscribe after it passes
+
+
+class AttestationSubnetService:
+    """Duty + random-subnet subscription tracker for the 64 attestation
+    subnets (attestation_subnets.rs AttestationService)."""
+
+    def __init__(self, spec, node_id: str = "", subscribe_all_subnets: bool = False):
+        self.spec = spec
+        self.node_id = node_id
+        self.subscribe_all_subnets = subscribe_all_subnets
+        self.slots_per_epoch = int(spec.preset.SLOTS_PER_EPOCH)
+        # subnet_id -> latest duty slot needing it (short-lived)
+        self._short: dict[int, int] = {}
+        # subnet_id -> expiry epoch (long-lived random, ENR-advertised)
+        self._random: dict[int, int] = {}
+        # validator_index -> last seen epoch
+        self._known_validators: dict[int, int] = {}
+        self._rng_counter = 0
+
+    # ------------------------------------------------------------- queries
+    def subscription_count(self) -> int:
+        if self.subscribe_all_subnets:
+            return g.ATTESTATION_SUBNET_COUNT
+        return len(set(self._short) | set(self._random))
+
+    def is_subscribed(self, subnet_id: int) -> bool:
+        return (
+            self.subscribe_all_subnets
+            or subnet_id in self._short
+            or subnet_id in self._random
+        )
+
+    def enr_bitfield(self) -> int:
+        """attnets bitfield: long-lived subnets only (reference advertises
+        random subnets in the ENR, not per-duty ones)."""
+        bits = 0
+        for subnet in self._random:
+            bits |= 1 << subnet
+        return bits
+
+    def should_process_attestation(self, subnet_id: int) -> bool:
+        """attestation_subnets.rs:246 — only fully process (as aggregator
+        input) attestations on subnets we actively subscribe to."""
+        return self.is_subscribed(subnet_id)
+
+    # --------------------------------------------------------- registration
+    def validator_subscriptions(
+        self, subscriptions: list[ValidatorSubscription], current_slot: int
+    ) -> list[SubnetMessage]:
+        """Process duty registrations (attestation_subnets.rs:153).
+
+        Registers validators (maintaining the random-subnet quota),
+        subscribes to the exact subnet for aggregator duties, and emits
+        peer-discovery requests for every distinct duty subnet keyed to
+        its highest duty slot (highest slot → highest min_ttl).
+        """
+        msgs: list[SubnetMessage] = []
+        current_epoch = current_slot // self.slots_per_epoch
+        to_discover: dict[int, int] = {}
+
+        for sub in subscriptions:
+            msgs += self._add_known_validator(sub.validator_index, current_epoch)
+            subnet_id = g.compute_subnet_for_attestation(
+                self.spec, sub.committee_count_at_slot, sub.slot, sub.committee_index
+            )
+            prev = to_discover.get(subnet_id)
+            if prev is None or sub.slot > prev:
+                to_discover[subnet_id] = sub.slot
+            if sub.is_aggregator:
+                msgs += self._subscribe_short(subnet_id, sub.slot)
+
+        for subnet_id, slot in sorted(to_discover.items()):
+            if slot + MIN_PEER_DISCOVERY_SLOT_LOOK_AHEAD >= current_slot:
+                msgs.append(
+                    SubnetMessage("discover_peers", "attestation", subnet_id,
+                                  min_ttl_slot=slot)
+                )
+        return msgs
+
+    def _subscribe_short(self, subnet_id: int, slot: int) -> list[SubnetMessage]:
+        prev = self._short.get(subnet_id)
+        self._short[subnet_id] = max(slot, prev) if prev is not None else slot
+        if prev is None and not self.is_random(subnet_id) \
+                and not self.subscribe_all_subnets:
+            return [SubnetMessage("subscribe", "attestation", subnet_id)]
+        return []
+
+    def is_random(self, subnet_id: int) -> bool:
+        return subnet_id in self._random
+
+    def _add_known_validator(self, index: int, epoch: int) -> list[SubnetMessage]:
+        new = index not in self._known_validators
+        self._known_validators[index] = epoch
+        if not new or self.subscribe_all_subnets:
+            return []
+        # attestation_subnets.rs:387-390 — top the random pool up to
+        # min(validators * per_validator, subnet_count)
+        want = min(
+            len(self._known_validators) * RANDOM_SUBNETS_PER_VALIDATOR,
+            g.ATTESTATION_SUBNET_COUNT,
+        )
+        msgs: list[SubnetMessage] = []
+        while len(self._random) < want:
+            msgs += self._subscribe_random(epoch)
+        return msgs
+
+    def _pick_random_subnet(self) -> int:
+        """Deterministic per-node pseudo-random subnet pick (the reference
+        uses thread_rng; determinism here keeps tests and the simulator
+        reproducible)."""
+        while True:
+            h = hashlib.sha256(
+                b"random-subnet" + self.node_id.encode()
+                + self._rng_counter.to_bytes(8, "little")
+            ).digest()
+            self._rng_counter += 1
+            subnet = h[0] % g.ATTESTATION_SUBNET_COUNT
+            if subnet not in self._random:
+                return subnet
+
+    def _subscribe_random(self, epoch: int) -> list[SubnetMessage]:
+        subnet = self._pick_random_subnet()
+        expiry = epoch + EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION
+        self._random[subnet] = expiry
+        msgs = [SubnetMessage("enr_add", "attestation", subnet)]
+        if subnet not in self._short:
+            msgs.insert(0, SubnetMessage("subscribe", "attestation", subnet))
+        return msgs
+
+    # ------------------------------------------------------------------ time
+    def tick(self, current_slot: int) -> list[SubnetMessage]:
+        """Advance to `current_slot`: expire short-lived subscriptions whose
+        duty slot passed, rotate expired random subnets, prune validators
+        unseen for LAST_SEEN_VALIDATOR_TIMEOUT epochs (shrinking the
+        random pool to the new quota)."""
+        msgs: list[SubnetMessage] = []
+        epoch = current_slot // self.slots_per_epoch
+
+        # expire short-lived (one-slot duty + EXPIRATION_TIMEOUT grace)
+        for subnet_id, slot in sorted(self._short.items()):
+            if current_slot > slot:
+                del self._short[subnet_id]
+                if not self.is_random(subnet_id) and not self.subscribe_all_subnets:
+                    msgs.append(
+                        SubnetMessage("unsubscribe", "attestation", subnet_id)
+                    )
+
+        # prune stale validators, then shrink/rotate the random pool
+        stale = [
+            v for v, seen in self._known_validators.items()
+            if epoch - seen > LAST_SEEN_VALIDATOR_TIMEOUT_EPOCHS
+        ]
+        for v in stale:
+            del self._known_validators[v]
+
+        want = min(
+            len(self._known_validators) * RANDOM_SUBNETS_PER_VALIDATOR,
+            g.ATTESTATION_SUBNET_COUNT,
+        )
+        expired = sorted(s for s, exp in self._random.items() if epoch >= exp)
+        for subnet in expired:
+            del self._random[subnet]
+            msgs.append(SubnetMessage("enr_remove", "attestation", subnet))
+            if subnet not in self._short and not self.subscribe_all_subnets:
+                msgs.append(SubnetMessage("unsubscribe", "attestation", subnet))
+        while len(self._random) > want:
+            subnet = sorted(self._random)[-1]
+            del self._random[subnet]
+            msgs.append(SubnetMessage("enr_remove", "attestation", subnet))
+            if subnet not in self._short and not self.subscribe_all_subnets:
+                msgs.append(SubnetMessage("unsubscribe", "attestation", subnet))
+        while len(self._random) < want:
+            msgs += self._subscribe_random(epoch)
+        return msgs
+
+
+class SyncCommitteeSubnetService:
+    """Sync-committee subnet tracker (sync_subnets.rs SyncCommitteeService):
+    subscriptions last until the end of the sync-committee period and are
+    advertised in the ENR `syncnets` bitfield."""
+
+    def __init__(self, spec, subscribe_all_subnets: bool = False):
+        self.spec = spec
+        self.subscribe_all_subnets = subscribe_all_subnets
+        self.slots_per_epoch = int(spec.preset.SLOTS_PER_EPOCH)
+        # subnet_id -> until_epoch (inclusive)
+        self._subnets: dict[int, int] = {}
+
+    @staticmethod
+    def subnets_for_indices(spec, indices) -> set[int]:
+        """Committee position -> subnet: position // (SYNC_COMMITTEE_SIZE /
+        SYNC_COMMITTEE_SUBNET_COUNT) (SyncSubnetId::compute_subnets)."""
+        per_subnet = int(spec.preset.SYNC_COMMITTEE_SIZE) // g.SYNC_COMMITTEE_SUBNET_COUNT
+        return {int(i) // per_subnet for i in indices}
+
+    def subscription_count(self) -> int:
+        if self.subscribe_all_subnets:
+            return g.SYNC_COMMITTEE_SUBNET_COUNT
+        return len(self._subnets)
+
+    def is_subscribed(self, subnet_id: int) -> bool:
+        return self.subscribe_all_subnets or subnet_id in self._subnets
+
+    def enr_bitfield(self) -> int:
+        bits = 0
+        for subnet in self._subnets:
+            bits |= 1 << subnet
+        return bits
+
+    def validator_subscriptions(
+        self, subscriptions: list[SyncCommitteeSubscription], current_slot: int
+    ) -> list[SubnetMessage]:
+        msgs: list[SubnetMessage] = []
+        to_discover: dict[int, int] = {}
+        for sub in subscriptions:
+            for subnet in sorted(
+                self.subnets_for_indices(self.spec, sub.sync_committee_indices)
+            ):
+                prev = self._subnets.get(subnet)
+                fresh = prev is None
+                self._subnets[subnet] = max(sub.until_epoch, prev or 0)
+                if fresh:
+                    if not self.subscribe_all_subnets:
+                        msgs.append(SubnetMessage("subscribe", "sync", subnet))
+                    msgs.append(SubnetMessage("enr_add", "sync", subnet))
+                until_slot = self._subnets[subnet] * self.slots_per_epoch
+                prev_ttl = to_discover.get(subnet)
+                if prev_ttl is None or until_slot > prev_ttl:
+                    to_discover[subnet] = until_slot
+        for subnet, until_slot in sorted(to_discover.items()):
+            msgs.append(
+                SubnetMessage("discover_peers", "sync", subnet,
+                              min_ttl_slot=until_slot)
+            )
+        return msgs
+
+    def tick(self, current_slot: int) -> list[SubnetMessage]:
+        msgs: list[SubnetMessage] = []
+        epoch = current_slot // self.slots_per_epoch
+        for subnet, until_epoch in sorted(self._subnets.items()):
+            if epoch > until_epoch:
+                del self._subnets[subnet]
+                msgs.append(SubnetMessage("enr_remove", "sync", subnet))
+                if not self.subscribe_all_subnets:
+                    msgs.append(SubnetMessage("unsubscribe", "sync", subnet))
+        return msgs

@@ -1,0 +1,135 @@
+"""Delayed re-processing of work that arrived too early.
+
+Capability mirror of `network/src/beacon_processor/work_reprocessing_queue.rs`:
+attestations (and aggregates) that reference a block the chain doesn't know
+yet are parked here instead of being dropped or penalized — the block is
+usually milliseconds behind on gossip. When the block imports, the parked
+work is re-queued at the front of the verification pipeline; anything still
+parked after QUEUED_ATTESTATION_DELAY_SLOTS expires. Early-arriving gossip
+blocks (slot not started yet, clock skew) are likewise held until their
+slot begins.
+
+The reference drives this with tokio DelayQueue timers; here expiry is
+slot-driven via ``tick(current_slot)`` to stay deterministic under the
+ManualSlotClock test model.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from .processor import BeaconProcessor, WorkEvent
+
+# work_reprocessing_queue.rs: ATTESTATIONS are held for ~1 slot (12s);
+# bounded at 16_384 parked attestations.
+QUEUED_ATTESTATION_DELAY_SLOTS = 1
+MAXIMUM_QUEUED_ATTESTATIONS = 16_384
+MAXIMUM_QUEUED_BLOCKS = 16
+# A "future" block more than this far ahead is not clock skew — don't
+# hold it (MAXIMUM_GOSSIP_CLOCK_DISPARITY is sub-slot in the reference).
+FUTURE_BLOCK_TOLERANCE_SLOTS = 1
+
+
+@dataclass
+class _Parked:
+    event: WorkEvent
+    expiry_slot: int
+
+
+class ReprocessQueue:
+    """Unknown-block attestation parking lot + early-block delay queue."""
+
+    def __init__(self, processor: BeaconProcessor,
+                 max_attestations: int = MAXIMUM_QUEUED_ATTESTATIONS,
+                 max_blocks: int = MAXIMUM_QUEUED_BLOCKS):
+        self.processor = processor
+        self.max_attestations = max_attestations
+        self.max_blocks = max_blocks
+        # block_root -> list of parked events awaiting that block
+        self._awaiting_block: "OrderedDict[bytes, list[_Parked]]" = OrderedDict()
+        self._parked_count = 0
+        # early gossip blocks: list of (release_slot, event)
+        self._early_blocks: list[tuple[int, WorkEvent]] = []
+        self.stats = {
+            "parked": 0,
+            "requeued": 0,
+            "expired": 0,
+            "dropped_full": 0,
+            "early_blocks": 0,
+        }
+
+    # ---------------------------------------------------------------- park
+    def queue_unknown_block_attestation(
+        self, event: WorkEvent, block_root: bytes, current_slot: int
+    ) -> bool:
+        """Park an attestation/aggregate whose beacon_block_root is not in
+        fork choice yet. Returns False if the lot is full (oldest dropped
+        behavior would risk unbounded latency — reference drops new)."""
+        if self._parked_count >= self.max_attestations:
+            self.stats["dropped_full"] += 1
+            return False
+        parked = _Parked(event, current_slot + QUEUED_ATTESTATION_DELAY_SLOTS)
+        self._awaiting_block.setdefault(bytes(block_root), []).append(parked)
+        self._parked_count += 1
+        self.stats["parked"] += 1
+        return True
+
+    def queue_early_block(self, event: WorkEvent, block_slot: int,
+                          current_slot: int) -> bool:
+        """Hold a gossip block whose slot hasn't started (clock skew).
+        Blocks beyond FUTURE_BLOCK_TOLERANCE_SLOTS aren't skew — they're
+        junk, and holding them would let 16 far-future blocks clog the
+        bounded queue forever."""
+        if block_slot - current_slot > FUTURE_BLOCK_TOLERANCE_SLOTS:
+            self.stats["dropped_full"] += 1
+            return False
+        if len(self._early_blocks) >= self.max_blocks:
+            self.stats["dropped_full"] += 1
+            return False
+        self._early_blocks.append((block_slot, event))
+        self.stats["early_blocks"] += 1
+        return True
+
+    # ------------------------------------------------------------- release
+    def on_block_imported(self, block_root: bytes) -> int:
+        """A block landed: requeue everything waiting on it
+        (work_reprocessing_queue.rs ReadyWork::Attestation path)."""
+        parked = self._awaiting_block.pop(bytes(block_root), None)
+        if not parked:
+            return 0
+        for p in parked:
+            self.processor.send(p.event)
+            self._parked_count -= 1
+            self.stats["requeued"] += 1
+        return len(parked)
+
+    def tick(self, current_slot: int) -> int:
+        """Expire overdue attestations; release early blocks whose slot
+        started. Returns events released back into the processor."""
+        released = 0
+        for root in list(self._awaiting_block):
+            keep = []
+            for p in self._awaiting_block[root]:
+                if current_slot > p.expiry_slot:
+                    self._parked_count -= 1
+                    self.stats["expired"] += 1
+                else:
+                    keep.append(p)
+            if keep:
+                self._awaiting_block[root] = keep
+            else:
+                del self._awaiting_block[root]
+
+        still_early = []
+        for slot, ev in self._early_blocks:
+            if current_slot >= slot:
+                self.processor.send(ev)
+                released += 1
+            else:
+                still_early.append((slot, ev))
+        self._early_blocks = still_early
+        return released
+
+    def parked(self) -> int:
+        return self._parked_count + len(self._early_blocks)

@@ -81,6 +81,7 @@ class BeaconNode:
         chain.per_slot_task()
         if self.network is not None:
             self.network.discover_and_connect()
+            self.network.subnet_tick()
             self.network.poll()
         if self.slasher is not None:
             p = self.spec.preset

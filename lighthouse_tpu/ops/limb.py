@@ -165,6 +165,27 @@ def _conv_schoolbook(a, b):
     return jnp.round(t).astype(jnp.int32)
 
 
+# mont_mul implementation switch: "xla" (default) or "pallas" (the fused
+# ops/pallas_mont.py kernel). Read at TRACE time — set it (or the
+# LHTPU_PALLAS_MONT_MUL=1 env var) before building jitted programs.
+_MONT_MUL_IMPL = "xla"
+
+
+def set_mont_mul_impl(name: str) -> None:
+    global _MONT_MUL_IMPL
+    if name not in ("xla", "pallas"):
+        raise ValueError(f"unknown mont_mul impl {name!r}")
+    _MONT_MUL_IMPL = name
+
+
+def _impl() -> str:
+    import os
+
+    if os.environ.get("LHTPU_PALLAS_MONT_MUL") == "1":
+        return "pallas"
+    return _MONT_MUL_IMPL
+
+
 def mont_mul(a, b):
     """Montgomery product a*b*R^{-1} mod p, batched.
 
@@ -180,9 +201,14 @@ def mont_mul(a, b):
     ops instead of ~150 per unrolled fold, which is what makes scan-heavy
     callers (Miller loop, Fermat inversion) compile in reasonable time.
 
-    This is the single hot primitive of the whole framework — the Pallas/MXU
-    kernel will replace exactly this function.
+    This is the single hot primitive of the whole framework — the fused
+    Pallas/MXU kernel (ops/pallas_mont.py, selected via
+    :func:`set_mont_mul_impl`) replaces exactly this function.
     """
+    if _impl() == "pallas":
+        from .pallas_mont import mont_mul_pallas
+
+        return mont_mul_pallas(a, b)
     t = _conv_schoolbook(a, b)
 
     def step(t, _):

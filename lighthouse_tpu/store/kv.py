@@ -50,6 +50,13 @@ class MemoryStore:
             if k.startswith(prefix):
                 yield k[len(prefix):], self._data[k]
 
+    def iter_keys(self, column: bytes) -> Iterator[bytes]:
+        """Key-only scan (no value materialization)."""
+        prefix = column + b":"
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k[len(prefix):]
+
     def compact(self) -> None:
         pass
 
@@ -149,6 +156,29 @@ class KVStore:
                 finally:
                     self._lib.lhkv_free(k)
                     self._lib.lhkv_free(v)
+        finally:
+            self._lib.lhkv_iter_close(it)
+
+    def iter_keys(self, column: bytes) -> Iterator[bytes]:
+        """Key-only scan via lhkv_iter_next_key — no value pread, so
+        counting a column never touches the log's value bytes."""
+        prefix = column + b":"
+        it = self._lib.lhkv_iter(self._db, prefix, len(prefix))
+        try:
+            while True:
+                k = ctypes.POINTER(ctypes.c_uint8)()
+                klen = ctypes.c_size_t()
+                rc = self._lib.lhkv_iter_next_key(
+                    it, ctypes.byref(k), ctypes.byref(klen)
+                )
+                if rc == 1:
+                    return
+                if rc != 0:
+                    raise IOError(f"lhkv_iter_next_key rc={rc}")
+                try:
+                    yield ctypes.string_at(k, klen.value)[len(prefix):]
+                finally:
+                    self._lib.lhkv_free(k)
         finally:
             self._lib.lhkv_iter_close(it)
 

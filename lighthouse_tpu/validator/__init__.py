@@ -15,6 +15,8 @@ slashing_protection 3.5k LoC).
   (beacon_node_fallback.rs).
 * ``doppelganger`` — liveness watch refusing to sign while another
   instance of the key may be active (doppelganger_service.rs).
+* ``web3signer`` — remote signing over HTTP (signing_method.rs
+  SigningMethod::Web3Signer + testing/web3signer_tests).
 """
 
 from .doppelganger import DoppelgangerService
@@ -24,6 +26,7 @@ from .keystore import Keystore, derive_master_sk, derive_validator_keys
 from .services import AttestationService, BlockService, ValidatorClient
 from .slashing_protection import SlashingDatabase, SlashingError
 from .store import ValidatorStore
+from .web3signer import Web3SignerClient, Web3SignerError, Web3SignerServer
 
 __all__ = [
     "AttestationService",
@@ -36,6 +39,9 @@ __all__ = [
     "SlashingError",
     "ValidatorClient",
     "ValidatorStore",
+    "Web3SignerClient",
+    "Web3SignerError",
+    "Web3SignerServer",
     "derive_master_sk",
     "derive_validator_keys",
 ]

@@ -121,6 +121,31 @@ class DutiesService:
             )
             duties.attesters[duty.validator_index] = duty
         self._attesters[epoch] = duties
+        self._post_subnet_subscriptions(duties)
+
+    def _post_subnet_subscriptions(self, duties: "_EpochDuties") -> None:
+        """Tell the BN which attestation subnets this VC's duties need
+        (duties_service.rs post_validator_beacon_committee_subscriptions
+        → BN subnet_service). Best-effort: older BNs without the
+        endpoint are tolerated."""
+        subs = [
+            {
+                "validator_index": d.validator_index,
+                "committee_index": d.committee_index,
+                "slot": d.slot,
+                "committees_at_slot": d.committees_at_slot,
+                "is_aggregator": d.is_aggregator,
+            }
+            for d in duties.attesters.values()
+        ]
+        if not subs:
+            return
+        try:
+            self._call(
+                lambda c: c.post_beacon_committee_subscriptions(subs)
+            )
+        except Exception:
+            pass
 
     def _poll_proposers(self, epoch: int) -> None:
         resp = self._call(lambda c: c.get_proposer_duties(epoch))

@@ -57,7 +57,8 @@ class ValidatorStore:
         self._indices[pubkey] = index
 
     # ---------------------------------------------------------------- signing
-    def _raw_sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+    def _raw_sign(self, pubkey: bytes, signing_root: bytes,
+                  message_type: str | None = None) -> bytes:
         signer = self._signers.get(pubkey)
         if signer is None:
             raise KeyError(f"no signer for {pubkey.hex()[:16]}…")
@@ -65,7 +66,26 @@ class ValidatorStore:
             raise SlashingError("doppelganger protection: signing disabled")
         if isinstance(signer, SecretKey):
             return signer.sign(signing_root).to_bytes()
-        return signer(signing_root)  # remote / web3signer-style
+        # remote / web3signer-style callable; typed signers get the
+        # Web3Signer message type (signing_method.rs request body).
+        # Capability is probed from the signature up-front — catching
+        # TypeError around the live call would mask signer bugs and
+        # double-send the request.
+        if message_type is not None and self._accepts_message_type(signer):
+            return signer(signing_root, message_type=message_type)
+        return signer(signing_root)
+
+    @staticmethod
+    def _accepts_message_type(signer) -> bool:
+        import inspect
+
+        try:
+            params = inspect.signature(signer).parameters
+        except (TypeError, ValueError):
+            return False
+        return "message_type" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
 
     def _domain(self, domain_type: bytes, epoch: int, fork) -> bytes:
         return self.spec.get_domain(
@@ -75,7 +95,7 @@ class ValidatorStore:
     def randao_reveal(self, pubkey: bytes, epoch: int, fork) -> bytes:
         domain = self._domain(self.spec.DOMAIN_RANDAO, epoch, fork)
         root = merkleize_chunks([uint64.hash_tree_root(epoch), domain])
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="RANDAO_REVEAL")
 
     def sign_block(self, pubkey: bytes, block, fork) -> bytes:
         p = self.spec.preset
@@ -85,7 +105,7 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, int(block.slot), root
         )
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="BLOCK_V2")
 
     def sign_attestation(self, pubkey: bytes, data, fork) -> bytes:
         domain = self._domain(
@@ -95,21 +115,21 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, int(data.source.epoch), int(data.target.epoch), root
         )
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="ATTESTATION")
 
     def sign_selection_proof(self, pubkey: bytes, slot: int, fork) -> bytes:
         p = self.spec.preset
         epoch = slot // p.SLOTS_PER_EPOCH
         domain = self._domain(self.spec.DOMAIN_SELECTION_PROOF, epoch, fork)
         root = merkleize_chunks([uint64.hash_tree_root(slot), domain])
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="AGGREGATION_SLOT")
 
     def sign_aggregate_and_proof(self, pubkey: bytes, message, fork) -> bytes:
         p = self.spec.preset
         epoch = int(message.aggregate.data.slot) // p.SLOTS_PER_EPOCH
         domain = self._domain(self.spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, fork)
         root = compute_signing_root(message, domain)
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="AGGREGATE_AND_PROOF")
 
     def sign_sync_committee_message(self, pubkey: bytes, slot: int,
                                     block_root: bytes, fork) -> bytes:
@@ -117,7 +137,7 @@ class ValidatorStore:
         epoch = slot // p.SLOTS_PER_EPOCH
         domain = self._domain(self.spec.DOMAIN_SYNC_COMMITTEE, epoch, fork)
         root = merkleize_chunks([bytes(block_root), domain])
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="SYNC_COMMITTEE_MESSAGE")
 
     def sign_sync_selection_proof(self, pubkey: bytes, slot: int,
                                   subcommittee_index: int, fork) -> bytes:
@@ -131,7 +151,8 @@ class ValidatorStore:
         data = SyncAggregatorSelectionData(
             slot=slot, subcommittee_index=subcommittee_index
         )
-        return self._raw_sign(pubkey, compute_signing_root(data, domain))
+        return self._raw_sign(pubkey, compute_signing_root(data, domain),
+                              message_type="SYNC_COMMITTEE_SELECTION_PROOF")
 
     def sign_contribution_and_proof(self, pubkey: bytes, message, fork) -> bytes:
         p = self.spec.preset
@@ -139,11 +160,12 @@ class ValidatorStore:
         domain = self._domain(
             self.spec.DOMAIN_CONTRIBUTION_AND_PROOF, epoch, fork
         )
-        return self._raw_sign(pubkey, compute_signing_root(message, domain))
+        return self._raw_sign(pubkey, compute_signing_root(message, domain),
+                              message_type="SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF")
 
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg, fork) -> bytes:
         domain = self._domain(
             self.spec.DOMAIN_VOLUNTARY_EXIT, int(exit_msg.epoch), fork
         )
         root = compute_signing_root(exit_msg, domain)
-        return self._raw_sign(pubkey, root)
+        return self._raw_sign(pubkey, root, message_type="VOLUNTARY_EXIT")

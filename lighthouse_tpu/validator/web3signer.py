@@ -1,0 +1,130 @@
+"""Web3Signer-style remote signing over HTTP.
+
+Capability mirror of `validator_client/src/signing_method.rs:78-169`
+(`SigningMethod::Web3Signer`) plus the `testing/web3signer_tests` model:
+the VC holds no key material; each signing request is POSTed as JSON to
+``/api/v1/eth2/sign/{pubkey}`` on a remote signer, which responds with the
+BLS signature. The remote API shape follows the Consensys Web3Signer
+eth2 interface the reference speaks: a typed body carrying the message
+type and the 32-byte signing root (the root is what's signed — domain
+separation already happened on the VC side, exactly as in
+`signing_method.rs` where `SignableMessage::signing_root` is computed
+before dispatch).
+
+``Web3SignerClient`` is registered in the ``ValidatorStore`` through the
+store's callable-signer seam: it's invoked with the signing root (plus
+optional message-type metadata) and returns signature bytes.
+``Web3SignerServer`` is the in-process signer used by tests — the
+equivalent of the real Java Web3Signer in `testing/web3signer_tests`,
+asserting remote signatures are byte-identical to local signing.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+from ..common.support import HttpServerLifecycle, JsonHttpHandler
+from ..crypto.bls.api import SecretKey
+
+# signing_method.rs / Web3Signer eth2 API message types
+MESSAGE_TYPES = frozenset({
+    "AGGREGATION_SLOT",
+    "AGGREGATE_AND_PROOF",
+    "ATTESTATION",
+    "BLOCK_V2",
+    "RANDAO_REVEAL",
+    "SYNC_COMMITTEE_MESSAGE",
+    "SYNC_COMMITTEE_SELECTION_PROOF",
+    "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF",
+    "VOLUNTARY_EXIT",
+    "VALIDATOR_REGISTRATION",
+})
+
+
+class Web3SignerError(Exception):
+    pass
+
+
+class Web3SignerClient:
+    """Callable signer: ``client(signing_root)`` → 96-byte signature.
+
+    One client per validator pubkey (mirroring SigningMethod::Web3Signer
+    which carries the per-validator request URL)."""
+
+    def __init__(self, base_url: str, pubkey: bytes, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.pubkey = pubkey
+        self.timeout = timeout
+
+    @property
+    def url(self) -> str:
+        return f"{self.base_url}/api/v1/eth2/sign/0x{self.pubkey.hex()}"
+
+    def __call__(self, signing_root: bytes,
+                 message_type: str = "BLOCK_V2") -> bytes:
+        if message_type not in MESSAGE_TYPES:
+            raise Web3SignerError(f"unknown message type {message_type}")
+        body = json.dumps({
+            "type": message_type,
+            "signingRoot": "0x" + bytes(signing_root).hex(),
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise Web3SignerError(f"signer returned HTTP {e.code}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise Web3SignerError(f"signer unreachable: {e}") from e
+        sig = payload.get("signature", "")
+        if not sig.startswith("0x") or len(sig) != 2 + 96 * 2:
+            raise Web3SignerError("malformed signature in response")
+        return bytes.fromhex(sig[2:])
+
+
+class Web3SignerServer(HttpServerLifecycle):
+    """In-process remote signer holding the secret keys (the test stand-in
+    for the Java Web3Signer; `testing/web3signer_tests/src/lib.rs`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._keys: dict[bytes, SecretKey] = {}
+        self.requests: list[dict] = []  # observed request bodies (for tests)
+        server = self
+
+        class Handler(JsonHttpHandler, BaseHTTPRequestHandler):
+            def do_POST(self):
+                prefix = "/api/v1/eth2/sign/0x"
+                if not self.path.startswith(prefix):
+                    self.send_error(404)
+                    return
+                try:
+                    pubkey = bytes.fromhex(self.path[len(prefix):])
+                    body = self.read_json() or {}
+                except ValueError:
+                    self.send_error(400)
+                    return
+                server.requests.append({"pubkey": pubkey, **body})
+                sk = server._keys.get(pubkey)
+                root_hex = body.get("signingRoot", "")
+                if sk is None:
+                    self.send_error(404, "unknown key")
+                    return
+                if not root_hex.startswith("0x") or len(root_hex) != 66:
+                    self.send_error(400, "bad signing root")
+                    return
+                sig = sk.sign(bytes.fromhex(root_hex[2:])).to_bytes()
+                self.send_json(200, {"signature": "0x" + sig.hex()})
+
+        self._init_http(Handler, host, port)
+
+    def add_key(self, sk: SecretKey) -> bytes:
+        pubkey = sk.public_key().to_bytes()
+        self._keys[pubkey] = sk
+        return pubkey

@@ -1,0 +1,113 @@
+"""Tests for the API additions: pool slashing endpoints and subnet
+subscription endpoints over HTTP (reference model: http_api pool +
+validator subscription handlers)."""
+
+import pytest
+
+from lighthouse_tpu.api import (
+    ApiError,
+    BeaconApi,
+    BeaconNodeClient,
+    HttpServer,
+    container_to_json,
+)
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.consensus.types import (
+    BeaconBlockHeader,
+    ProposerSlashing,
+    SignedBeaconBlockHeader,
+)
+from lighthouse_tpu.network import InMemoryHub, NetworkService
+
+
+def _proposer_slashing(proposer_index=0, slot=1):
+    h1 = BeaconBlockHeader(slot=slot, proposer_index=proposer_index,
+                           body_root=b"\x01" * 32)
+    h2 = BeaconBlockHeader(slot=slot, proposer_index=proposer_index,
+                           body_root=b"\x02" * 32)
+    inf = b"\xc0" + bytes(95)
+    return ProposerSlashing(
+        signed_header_1=SignedBeaconBlockHeader(message=h1, signature=inf),
+        signed_header_2=SignedBeaconBlockHeader(message=h2, signature=inf),
+    )
+
+
+@pytest.fixture()
+def node():
+    harness = BeaconChainHarness(validator_count=16)
+    hub = InMemoryHub()
+    network = NetworkService(harness.chain, hub, "api-node",
+                             subscribe_all_subnets=False)
+    api = BeaconApi(harness.chain, network=network)
+    server = HttpServer(api).start()
+    client = BeaconNodeClient(url=server.url)
+    yield harness, network, client
+    server.stop()
+
+
+class TestSlashingPool:
+    def test_proposer_slashing_accepted(self, node):
+        harness, network, client = node
+        slashing = _proposer_slashing()
+        client.post_proposer_slashing(container_to_json(slashing))
+        proposer, _ = harness.chain.op_pool.get_slashings(
+            harness.chain.head().state
+        )
+        assert len(proposer) == 1
+
+    def test_invalid_proposer_slashing_400(self, node):
+        harness, network, client = node
+        h1 = BeaconBlockHeader(slot=1, proposer_index=0,
+                               body_root=b"\x01" * 32)
+        inf = b"\xc0" + bytes(95)
+        identical = ProposerSlashing(
+            signed_header_1=SignedBeaconBlockHeader(message=h1, signature=inf),
+            signed_header_2=SignedBeaconBlockHeader(message=h1, signature=inf),
+        )
+        with pytest.raises(ApiError) as e:
+            client.post_proposer_slashing(container_to_json(identical))
+        assert e.value.status == 400
+
+    def test_attester_slashing_accepted(self, node):
+        harness, network, client = node
+        types = harness.chain.types
+        state = harness.chain.head().state
+        data1 = harness.chain.produce_unaggregated_attestation(0, 0).data
+        data2 = type(data1)(
+            slot=data1.slot, index=data1.index,
+            beacon_block_root=b"\x07" * 32,
+            source=data1.source, target=data1.target,
+        )
+        inf = b"\xc0" + bytes(95)
+        att1 = types.IndexedAttestation(
+            attesting_indices=[0, 1], data=data1, signature=inf
+        )
+        att2 = types.IndexedAttestation(
+            attesting_indices=[0, 1], data=data2, signature=inf
+        )
+        slashing = types.AttesterSlashing(attestation_1=att1,
+                                          attestation_2=att2)
+        client.post_attester_slashing(container_to_json(slashing))
+        _, attester = harness.chain.op_pool.get_slashings(
+            harness.chain.head().state
+        )
+        assert len(attester) == 1
+
+
+class TestSubscriptionEndpoints:
+    def test_beacon_committee_subscriptions(self, node):
+        harness, network, client = node
+        slot = harness.chain.current_slot() + 2
+        client.post_beacon_committee_subscriptions([
+            {"validator_index": 1, "committee_index": 0, "slot": slot,
+             "committees_at_slot": 4, "is_aggregator": True},
+        ])
+        assert network.attestation_subnets.subscription_count() >= 1
+
+    def test_sync_committee_subscriptions(self, node):
+        harness, network, client = node
+        client.post_sync_committee_subscriptions([
+            {"validator_index": 0, "sync_committee_indices": [0],
+             "until_epoch": 4},
+        ])
+        assert network.sync_subnets.is_subscribed(0)

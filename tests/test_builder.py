@@ -1,0 +1,81 @@
+"""Builder-client tests (reference model: builder_client/src/lib.rs +
+execution_layer test_utils mock_builder): registration, header bids,
+blinded-block reveal, withholding builder."""
+
+import pytest
+
+from lighthouse_tpu.execution import (
+    BuilderError,
+    BuilderHttpClient,
+    ExecutionBlockGenerator,
+    MockBuilder,
+)
+from lighthouse_tpu.execution.builder import header_json_from_payload_json
+
+
+@pytest.fixture()
+def builder():
+    gen = ExecutionBlockGenerator()
+    b = MockBuilder(gen).start()
+    yield b, gen
+    b.stop()
+
+
+PUBKEY = b"\xbb" * 48
+
+
+class TestBuilderFlow:
+    def test_status(self, builder):
+        b, _ = builder
+        assert BuilderHttpClient(b.url).status()
+
+    def test_register_get_header_submit(self, builder):
+        b, gen = builder
+        client = BuilderHttpClient(b.url)
+        client.register_validators([
+            {"message": {"pubkey": "0x" + PUBKEY.hex(),
+                         "fee_recipient": "0x" + "11" * 20,
+                         "gas_limit": "30000000"}}
+        ])
+        assert PUBKEY in b.registrations
+
+        parent = gen.head_hash
+        bid = client.get_header(slot=1, parent_hash=parent, pubkey=PUBKEY)
+        header = bid["header"]
+        assert int(bid["value"]) > 0
+        assert header["parentHash"] == "0x" + parent.hex()
+        # registered fee recipient honored
+        assert header["feeRecipient"] == "0x" + "11" * 20
+        assert "transactions" not in header and "transactionsRoot" in header
+
+        # proposer signs a blinded block over the header and submits
+        blinded = {
+            "message": {"body": {"execution_payload_header": header}},
+            "signature": "0x" + "00" * 96,
+        }
+        payload = client.submit_blinded_block(blinded)
+        assert payload["blockHash"] == header["blockHash"]
+        assert "transactions" in payload
+        # the revealed payload's header re-derives to the bid header
+        assert header_json_from_payload_json(payload) == header
+
+    def test_withholding_builder_rejects_reveal(self, builder):
+        b, gen = builder
+        client = BuilderHttpClient(b.url)
+        bid = client.get_header(slot=1, parent_hash=gen.head_hash, pubkey=PUBKEY)
+        b.missing_payloads = True
+        with pytest.raises(BuilderError):
+            client.submit_blinded_block(
+                {"message": {"body": {
+                    "execution_payload_header": bid["header"]}}}
+            )
+
+    def test_unknown_parent_404(self, builder):
+        b, _ = builder
+        with pytest.raises(BuilderError):
+            BuilderHttpClient(b.url).get_header(
+                slot=1, parent_hash=b"\xfe" * 32, pubkey=PUBKEY
+            )
+
+    def test_unreachable_builder(self):
+        assert not BuilderHttpClient("http://127.0.0.1:1").status()

@@ -1,0 +1,81 @@
+"""CLI additions: lcli transition-blocks / insecure-validators, the
+boot-node flag plumbing, and malloc tuning (reference models:
+lcli/src/transition_blocks.rs, lcli insecure_validators,
+common/malloc_utils)."""
+
+import json
+import os
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.cli import main
+from lighthouse_tpu.common.malloc_utils import (
+    configure_memory_allocator,
+    scrape_allocator_metrics,
+)
+from lighthouse_tpu.consensus.config import minimal_spec
+
+
+class TestTransitionBlocks:
+    def test_replay_matches_harness(self, tmp_path, capsys):
+        h = BeaconChainHarness(validator_count=8, spec=minimal_spec())
+        pre = h.chain.head().state
+        pre_path = tmp_path / "pre.ssz"
+        pre_path.write_bytes(pre.encode())
+
+        h.advance_slot()
+        signed = h.make_block()
+        h.chain.process_block(signed)
+        blk_path = tmp_path / "blk.ssz"
+        blk_path.write_bytes(signed.encode())
+        post_path = tmp_path / "post.ssz"
+
+        rc = main([
+            "lcli", "--spec", "minimal", "transition-blocks",
+            "--pre-state", str(pre_path), "--block", str(blk_path),
+            "--post-state", str(post_path), "--no-signature-verification",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        head = h.chain.head().state
+        assert out["slot"] == int(head.slot)
+        assert out["state_root"] == "0x" + head.hash_tree_root().hex()
+        assert post_path.read_bytes() == head.encode()
+
+
+class TestInsecureValidators:
+    def test_writes_keystores_and_secrets(self, tmp_path, capsys):
+        rc = main([
+            "lcli", "--spec", "minimal", "insecure-validators",
+            "--count", "3", "--base-dir", str(tmp_path),
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["validators_written"] == 3
+        vdirs = os.listdir(tmp_path / "validators")
+        secrets = os.listdir(tmp_path / "secrets")
+        assert len(vdirs) == 3 and len(secrets) == 3
+
+        # keystore decrypts under the stored secret and matches interop key
+        from lighthouse_tpu.consensus.genesis import interop_keypairs
+        from lighthouse_tpu.validator.keystore import Keystore
+
+        keys = {sk.public_key().to_bytes().hex(): sk
+                for sk in interop_keypairs(3)}
+        for vdir in vdirs:
+            with open(tmp_path / "validators" / vdir /
+                      "voting-keystore.json") as f:
+                ks = Keystore.from_json(f.read())
+            with open(tmp_path / "secrets" / vdir) as f:
+                password = f.read()
+            sk = ks.decrypt(password)
+            assert sk.sk == keys[vdir[2:]].sk
+
+
+class TestMallocUtils:
+    def test_configure_and_scrape(self):
+        # glibc on this image: tuning applies and mallinfo2 scrapes
+        assert configure_memory_allocator() in (True, False)
+        metrics = scrape_allocator_metrics()
+        if metrics:  # glibc path
+            assert metrics["arena"] > 0
+            assert set(metrics) >= {"arena", "hblks", "uordblks"}

@@ -88,3 +88,29 @@ class TestSchemaChange:
         migrate_schema(db, 1)
         with pytest.raises(StoreError, match="no migration path"):
             migrate_schema(db, 3)
+
+
+class TestIterKeys:
+    """Key-only scans (lhkv_iter_next_key / MemoryStore.iter_keys): same
+    keys as iter_column, no value materialization."""
+
+    def test_memory_store(self):
+        db = MemoryStore()
+        for i in range(5):
+            db.put(b"blk", bytes([i]), b"v" * 100)
+        db.put(b"oth", b"\x09", b"x")
+        assert list(db.iter_keys(b"blk")) == [bytes([i]) for i in range(5)]
+        assert list(db.iter_keys(b"oth")) == [b"\x09"]
+
+    def test_native_store(self, tmp_path):
+        from lighthouse_tpu.store.kv import KVStore
+
+        db = KVStore(str(tmp_path / "kv.log"))
+        try:
+            for i in range(5):
+                db.put(b"blk", bytes([i]), b"v" * 100)
+            assert list(db.iter_keys(b"blk")) == [
+                k for k, _ in db.iter_column(b"blk")
+            ]
+        finally:
+            db.close()

@@ -1,0 +1,188 @@
+"""Subnet-service tests (reference model: network/src/subnet_service/tests):
+duty-driven subscribe/unsubscribe timing, long-lived random subnets with ENR
+advertisement, sync-committee period subscriptions, and NetworkService wiring."""
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.consensus.config import minimal_spec
+from lighthouse_tpu.network import InMemoryHub, NetworkService
+from lighthouse_tpu.network import gossip as g
+from lighthouse_tpu.network.subnet_service import (
+    ADVANCE_SUBSCRIBE_SLOTS,
+    EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION,
+    AttestationSubnetService,
+    SubnetMessage,
+    SyncCommitteeSubnetService,
+    SyncCommitteeSubscription,
+    ValidatorSubscription,
+)
+
+
+def _spec():
+    return minimal_spec()
+
+
+def _sub(v=0, committee=0, slot=10, count=4, agg=True):
+    return ValidatorSubscription(
+        validator_index=v,
+        committee_index=committee,
+        slot=slot,
+        committee_count_at_slot=count,
+        is_aggregator=agg,
+    )
+
+
+class TestAttestationSubnets:
+    def test_aggregator_duty_subscribes_exact_subnet(self):
+        svc = AttestationSubnetService(_spec(), node_id="n0")
+        msgs = svc.validator_subscriptions([_sub(slot=10, committee=1)], current_slot=8)
+        subnet = g.compute_subnet_for_attestation(_spec(), 4, 10, 1)
+        assert SubnetMessage("subscribe", "attestation", subnet) in msgs
+        assert svc.is_subscribed(subnet)
+        # a discovery request for the duty subnet rides along
+        assert any(
+            m.action == "discover_peers" and m.subnet_id == subnet and m.min_ttl_slot == 10
+            for m in msgs
+        )
+
+    def test_non_aggregator_discovers_but_does_not_subscribe(self):
+        svc = AttestationSubnetService(_spec(), node_id="n0")
+        # strip the random-subnet noise by pre-registering the validator
+        svc.validator_subscriptions([_sub(agg=True, slot=5)], current_slot=4)
+        before = svc.subscription_count()
+        msgs = svc.validator_subscriptions(
+            [_sub(v=0, committee=2, slot=20, agg=False)], current_slot=18
+        )
+        assert not any(m.action == "subscribe" for m in msgs)
+        assert any(m.action == "discover_peers" for m in msgs)
+        assert svc.subscription_count() == before
+
+    def test_duty_subscription_expires_after_slot(self):
+        svc = AttestationSubnetService(_spec(), node_id="n1")
+        msgs = svc.validator_subscriptions([_sub(slot=10)], current_slot=10 - ADVANCE_SUBSCRIBE_SLOTS)
+        subnet = g.compute_subnet_for_attestation(_spec(), 4, 10, 0)
+        random_subnets = {m.subnet_id for m in msgs if m.action == "enr_add"}
+        msgs = svc.tick(11)
+        if subnet not in random_subnets:
+            assert SubnetMessage("unsubscribe", "attestation", subnet) in msgs
+            assert not svc.is_subscribed(subnet) or svc.is_random(subnet)
+
+    def test_random_subnet_registered_and_advertised(self):
+        svc = AttestationSubnetService(_spec(), node_id="n2")
+        msgs = svc.validator_subscriptions([_sub()], current_slot=0)
+        adds = [m for m in msgs if m.action == "enr_add"]
+        assert len(adds) == 1  # one validator → one random subnet
+        assert svc.enr_bitfield() == 1 << adds[0].subnet_id
+
+    def test_random_subnet_rotates_after_expiry(self):
+        svc = AttestationSubnetService(_spec(), node_id="n3")
+        svc.validator_subscriptions([_sub(slot=4)], current_slot=0)
+        old = set(svc._random)
+        slots_per_epoch = _spec().preset.SLOTS_PER_EPOCH
+        expiry_slot = (EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION + 1) * slots_per_epoch
+        # keep the validator fresh so the quota stays 1
+        svc.validator_subscriptions(
+            [_sub(slot=expiry_slot, agg=False)], current_slot=expiry_slot - 1
+        )
+        msgs = svc.tick(expiry_slot)
+        removed = {m.subnet_id for m in msgs if m.action == "enr_remove"}
+        assert old <= removed
+        assert len(svc._random) == 1  # rotated to a fresh one
+        assert set(svc._random) or True
+
+    def test_stale_validator_shrinks_random_pool(self):
+        svc = AttestationSubnetService(_spec(), node_id="n4")
+        svc.validator_subscriptions([_sub(v=i) for i in range(3)], current_slot=0)
+        assert len(svc._random) == 3
+        far = (200) * _spec().preset.SLOTS_PER_EPOCH  # > 150-epoch timeout
+        msgs = svc.tick(far)
+        assert len(svc._random) == 0
+        assert sum(1 for m in msgs if m.action == "enr_remove") >= 3
+
+    def test_subscribe_all_subnets_mode(self):
+        svc = AttestationSubnetService(_spec(), node_id="n5", subscribe_all_subnets=True)
+        msgs = svc.validator_subscriptions([_sub()], current_slot=0)
+        assert not any(m.action in ("subscribe", "enr_add") for m in msgs)
+        assert svc.subscription_count() == g.ATTESTATION_SUBNET_COUNT
+        assert svc.should_process_attestation(63)
+
+    def test_should_process_attestation_gates_unsubscribed(self):
+        svc = AttestationSubnetService(_spec(), node_id="n6")
+        assert not svc.should_process_attestation(7)
+
+
+class TestSyncSubnets:
+    def test_positions_map_to_subnets(self):
+        spec = _spec()
+        per = spec.preset.SYNC_COMMITTEE_SIZE // g.SYNC_COMMITTEE_SUBNET_COUNT
+        subs = SyncCommitteeSubnetService.subnets_for_indices(spec, [0, per, 2 * per + 1])
+        assert subs == {0, 1, 2}
+
+    def test_subscription_lasts_until_period_end(self):
+        spec = _spec()
+        svc = SyncCommitteeSubnetService(spec)
+        msgs = svc.validator_subscriptions(
+            [SyncCommitteeSubscription(0, (0,), until_epoch=4)], current_slot=0
+        )
+        assert SubnetMessage("subscribe", "sync", 0) in msgs
+        assert svc.enr_bitfield() == 1
+        # still live at the final epoch
+        assert svc.tick(4 * spec.preset.SLOTS_PER_EPOCH) == []
+        # expires the epoch after until_epoch
+        msgs = svc.tick(5 * spec.preset.SLOTS_PER_EPOCH)
+        assert SubnetMessage("unsubscribe", "sync", 0) in msgs
+        assert svc.enr_bitfield() == 0
+
+    def test_extension_keeps_highest_epoch(self):
+        svc = SyncCommitteeSubnetService(_spec())
+        svc.validator_subscriptions(
+            [SyncCommitteeSubscription(0, (0,), until_epoch=2)], current_slot=0
+        )
+        svc.validator_subscriptions(
+            [SyncCommitteeSubscription(1, (0,), until_epoch=9)], current_slot=0
+        )
+        assert svc._subnets[0] == 9
+
+
+class TestNetworkWiring:
+    def _node(self, hub, name, subscribe_all=False):
+        harness = BeaconChainHarness(validator_count=16)
+        return NetworkService(
+            harness.chain, hub, name, subscribe_all_subnets=subscribe_all
+        ), harness
+
+    def test_duty_subscription_updates_enr_and_topics(self):
+        hub = InMemoryHub()
+        svc, harness = self._node(hub, "a")
+        spec = harness.chain.spec
+        svc.process_attester_subscriptions(
+            [_sub(v=1, committee=0, slot=harness.chain.current_slot() + 2)]
+        )
+        assert svc.attestation_subnets.subscription_count() >= 1
+        # ENR now advertises the random subnet
+        enr = svc.discovery.local
+        assert enr.attnets == svc.attestation_subnets.enr_bitfield()
+        assert enr.attnets != 0
+
+    def test_sync_subscription_roundtrip(self):
+        hub = InMemoryHub()
+        svc, harness = self._node(hub, "b")
+        svc.process_sync_subscriptions(
+            [SyncCommitteeSubscription(0, (0, 1), until_epoch=1)]
+        )
+        assert svc.sync_subnets.is_subscribed(0)
+        assert svc.discovery.local.syncnets & 1
+
+    def test_subnet_tick_runs_in_node_loop(self):
+        hub = InMemoryHub()
+        svc, harness = self._node(hub, "c")
+        svc.process_attester_subscriptions(
+            [_sub(v=0, slot=harness.chain.current_slot() + 1)]
+        )
+        for _ in range(3):
+            harness.advance_slot()
+        svc.subnet_tick()  # must not raise; short-lived duty expired
+        assert all(
+            s >= harness.chain.current_slot()
+            or svc.attestation_subnets.is_random(sid)
+            for sid, s in svc.attestation_subnets._short.items()
+        )

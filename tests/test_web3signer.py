@@ -1,0 +1,93 @@
+"""Web3Signer remote-signing tests (reference model:
+testing/web3signer_tests — remote signatures must be byte-identical to
+local signing through the full ValidatorStore path)."""
+
+import pytest
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.consensus.genesis import interop_keypairs
+from lighthouse_tpu.validator import (
+    ValidatorStore,
+    Web3SignerClient,
+    Web3SignerError,
+    Web3SignerServer,
+)
+
+
+@pytest.fixture(scope="module")
+def signer():
+    server = Web3SignerServer().start()
+    yield server
+    server.stop()
+
+
+def _stores(harness, signer):
+    """Two stores over the same key: one local, one remote."""
+    sk = harness.keys[0]
+    pubkey = signer.add_key(sk)
+    local = ValidatorStore(harness.spec, harness.chain.genesis_validators_root)
+    local.add_validator(sk, validator_index=0)
+    remote = ValidatorStore(harness.spec, harness.chain.genesis_validators_root)
+    remote.add_validator(
+        Web3SignerClient(signer.url, pubkey), validator_index=0, pubkey=pubkey
+    )
+    return pubkey, local, remote
+
+
+class TestWeb3Signer:
+    def test_block_signature_byte_identical(self, signer):
+        harness = BeaconChainHarness(validator_count=2)
+        pk, local, remote = _stores(harness, signer)
+        fork = harness.chain.head().state.fork
+        block = harness.types.BLOCK_BY_FORK["phase0"](slot=1, proposer_index=0)
+        assert remote.sign_block(pk, block, fork) == local.sign_block(
+            pk, block, fork
+        )
+
+    def test_randao_and_selection_proof_identical(self, signer):
+        harness = BeaconChainHarness(validator_count=2)
+        pk, local, remote = _stores(harness, signer)
+        fork = harness.chain.head().state.fork
+        assert remote.randao_reveal(pk, 3, fork) == local.randao_reveal(pk, 3, fork)
+        assert remote.sign_selection_proof(pk, 5, fork) == local.sign_selection_proof(
+            pk, 5, fork
+        )
+
+    def test_slashing_protection_still_applies(self, signer):
+        """The remote path goes through the same slashing guards
+        (validator_store.rs wraps every SigningMethod)."""
+        from lighthouse_tpu.validator import SlashingError
+
+        harness = BeaconChainHarness(validator_count=2)
+        pk, _, remote = _stores(harness, signer)
+        fork = harness.chain.head().state.fork
+        block = harness.types.BLOCK_BY_FORK["phase0"](slot=2, proposer_index=0)
+        remote.sign_block(pk, block, fork)
+        other = harness.types.BLOCK_BY_FORK["phase0"](
+            slot=2, proposer_index=0, state_root=b"\x02" * 32
+        )
+        with pytest.raises(SlashingError):
+            remote.sign_block(pk, other, fork)
+
+    def test_unknown_key_raises(self, signer):
+        client = Web3SignerClient(signer.url, b"\x11" * 48)
+        with pytest.raises(Web3SignerError):
+            client(b"\x00" * 32)
+
+    def test_unreachable_signer_raises(self):
+        client = Web3SignerClient("http://127.0.0.1:1", b"\x11" * 48)
+        with pytest.raises(Web3SignerError):
+            client(b"\x00" * 32)
+
+    def test_request_shape(self, signer):
+        """The wire format is the Web3Signer eth2 sign API: typed body,
+        0x-hex signing root, per-pubkey URL."""
+        harness = BeaconChainHarness(validator_count=2)
+        pk, _, remote = _stores(harness, signer)
+        fork = harness.chain.head().state.fork
+        signer.requests.clear()
+        remote.randao_reveal(pk, 0, fork)
+        req = signer.requests[-1]
+        assert req["pubkey"] == pk
+        assert req["signingRoot"].startswith("0x") and len(req["signingRoot"]) == 66
+        assert req["type"] == "RANDAO_REVEAL"

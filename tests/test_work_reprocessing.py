@@ -1,0 +1,123 @@
+"""Reprocess-queue tests (reference model: work_reprocessing_queue.rs):
+unknown-block attestations park without peer penalty, requeue on block
+import, expire after the delay; early blocks release at their slot."""
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network import InMemoryHub, NetworkService
+from lighthouse_tpu.network.processor import BeaconProcessor, WorkEvent, WorkType
+from lighthouse_tpu.network.work_reprocessing import (
+    QUEUED_ATTESTATION_DELAY_SLOTS,
+    ReprocessQueue,
+)
+
+
+def _ev(payload="x", wt=WorkType.GOSSIP_ATTESTATION):
+    return WorkEvent(wt, payload)
+
+
+class TestReprocessQueue:
+    def test_park_and_requeue_on_import(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc)
+        root = b"\x01" * 32
+        assert q.queue_unknown_block_attestation(_ev("a"), root, current_slot=5)
+        assert q.queue_unknown_block_attestation(_ev("b"), root, current_slot=5)
+        assert q.parked() == 2
+        assert proc.pending() == 0
+        assert q.on_block_imported(root) == 2
+        assert q.parked() == 0
+        assert proc.pending() == 2
+        assert q.stats["requeued"] == 2
+
+    def test_unrelated_import_releases_nothing(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc)
+        q.queue_unknown_block_attestation(_ev(), b"\x01" * 32, current_slot=5)
+        assert q.on_block_imported(b"\x02" * 32) == 0
+        assert q.parked() == 1
+
+    def test_expiry_after_delay(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc)
+        q.queue_unknown_block_attestation(_ev(), b"\x03" * 32, current_slot=5)
+        q.tick(5 + QUEUED_ATTESTATION_DELAY_SLOTS)  # still within delay
+        assert q.parked() == 1
+        q.tick(5 + QUEUED_ATTESTATION_DELAY_SLOTS + 1)
+        assert q.parked() == 0
+        assert q.stats["expired"] == 1
+        assert proc.pending() == 0  # expired, not requeued
+        # a late import of the block finds nothing
+        assert q.on_block_imported(b"\x03" * 32) == 0
+
+    def test_bounded(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc, max_attestations=2)
+        assert q.queue_unknown_block_attestation(_ev(), b"r" * 32, 0)
+        assert q.queue_unknown_block_attestation(_ev(), b"r" * 32, 0)
+        assert not q.queue_unknown_block_attestation(_ev(), b"r" * 32, 0)
+        assert q.stats["dropped_full"] == 1
+
+    def test_early_block_released_at_slot(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc)
+        assert q.queue_early_block(
+            _ev("blk", WorkType.GOSSIP_BLOCK), block_slot=9, current_slot=8
+        )
+        assert q.tick(8) == 0
+        assert q.tick(9) == 1
+        assert proc.pending() == 1
+
+    def test_far_future_block_not_held(self):
+        proc = BeaconProcessor()
+        q = ReprocessQueue(proc)
+        assert not q.queue_early_block(
+            _ev("blk", WorkType.GOSSIP_BLOCK), block_slot=2**40, current_slot=5
+        )
+        assert q.parked() == 0  # 16 of these can't clog the queue
+
+
+class TestRouterIntegration:
+    def _two_nodes(self):
+        hub = InMemoryHub()
+        a = BeaconChainHarness(validator_count=16)
+        b = BeaconChainHarness(validator_count=16)
+        na = NetworkService(a.chain, hub, "a")
+        nb = NetworkService(b.chain, hub, "b")
+        na.send_status("b")
+        return hub, (a, na), (b, nb)
+
+    def test_attestation_before_block_reprocessed(self):
+        """Node B receives attestations for a block it hasn't imported yet:
+        they park (no peer penalty), then verify once the block arrives."""
+        hub, (a, na), (b, nb) = self._two_nodes()
+        a.advance_slot()
+        b.advance_slot()
+        signed = a.make_block()
+        a.chain.process_block(signed)
+        atts = [v.attestation for v in a.attest()]
+        assert atts
+
+        # deliver only the attestations to B (block withheld)
+        for att in atts:
+            nb.router.handle_gossip(
+                None,
+                type("M", (), {"kind": "beacon_attestation_0", "item": att})(),
+                "a",
+                b"mid",
+            )
+        nb.processor.process_pending()
+        parked = nb.router.reprocess.parked()
+        assert parked == len(atts)
+        assert nb.router.stats["attestations_rejected"] == 0
+        assert nb.peer_manager.score("a") >= 0  # no penalty
+
+        # now the block lands; parked attestations verify on the next drain
+        nb.router.handle_gossip(
+            None,
+            type("M", (), {"kind": "beacon_block", "item": signed})(),
+            "a",
+            b"mid2",
+        )
+        nb.processor.process_pending()
+        assert nb.router.reprocess.parked() == 0
+        assert nb.router.stats["attestations_verified"] == len(atts)
