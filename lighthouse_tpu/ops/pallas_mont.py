@@ -3,34 +3,31 @@
 This is the fused-kernel replacement announced in ops/limb.py: the XLA
 path materializes the [*, 2304] outer product, the [*, 96] convolution
 columns, and 48 scan steps as separate HLO ops with loop state bouncing
-through HBM; here the whole CIOS pipeline — schoolbook convolution
-(MXU), 48-digit Montgomery fold, and final carry normalization — runs
-inside one Pallas program per batch tile with every intermediate held in
-VMEM.
+through HBM; here the whole CIOS pipeline — schoolbook convolution,
+48-digit Montgomery fold, and final carry normalization — runs inside
+one Pallas program per batch tile with every intermediate held in VMEM.
 
-Layout strategy (everything stays 2-D; Mosaic dislikes reshapes across
-the lane axis):
+Layout strategy — **limbs on sublanes, batch on lanes**: operands are
+fed transposed as [48, T] tiles. That makes every step of both loops a
+statically-sliced full-width VPU op:
 
-* ``a_rep = a @ REP`` and ``b_til = b @ TIL`` expand the [T, 48]
-  operands to aligned [T, 2304] layouts (REP repeats limb i into lanes
-  i*48..i*48+47, TIL tiles b's limbs across the 48 groups) — one-hot
-  f32 matmuls are exact (each output lane sums exactly one ≤255 term).
-* ``outer = a_rep * b_til`` is the full schoolbook product set (VPU,
-  products ≤ 255² exact in f32).
-* ``t = outer @ CONV`` collapses products into the 96 convolution
-  columns (CONV[i*48+j, i+j] = 1); column sums < 48·255² < 2²² so
-  full-precision f32 accumulation is exact. This is the MXU workload.
-* The fold/normalize loops use one-hot column masks instead of dynamic
-  lane slicing: extract column i with a masked reduce, add the shifted
-  p-multiple via the PSHIFT[48, 96] constant row, push the carry with a
-  mask — all full-width VPU ops.
+* convolution: ``t[i:i+48, :] += b * a[i, :]`` for i in 0..47 (the true
+  2304-MAC schoolbook, unrolled with static sublane windows — no MXU
+  detour through the 96×-redundant one-hot matmul the XLA path uses);
+* Montgomery fold: read digit row i, derive the quotient digit m, add
+  ``m * p`` into rows i..i+47, push the carry into row i+1;
+* normalization: sequential carry walk over rows 48..95.
 
-Exactness invariants match ops/limb.py mont_mul exactly (inputs in
-[0, 2p), output in [0, 2p), limbs normalized); equivalence is
-property-tested against the XLA path and the big-int oracle.
+Everything is int32; column/row values stay < 2^23 (48·255² conv bound
+plus fold contributions) so no mid-kernel carries are needed, matching
+ops/limb.py's invariants (inputs [0, 2p), output [0, 2p), limbs
+normalized). `m = (t_i · (-p⁻¹)) & 255` relies on int32 wraparound
+preserving the low 8 bits, same as the XLA path.
 
-Opt-in: set ``LHTPU_PALLAS_MONT_MUL=1`` (read at trace time) or call
-``limb.set_mont_mul_impl("pallas")`` before building jitted programs.
+Opt-in: ``limb.set_mont_mul_impl("pallas")`` (or LHTPU_PALLAS_MONT_MUL=1)
+before building jitted programs; equivalence is property-tested against
+the XLA path and the big-int oracle, and re-checked on the real chip by
+bench.py's exactness gate when enabled there.
 """
 
 from __future__ import annotations
@@ -45,105 +42,65 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .limb import LIMB_BITS, LIMB_MASK, N_LIMBS, NINV8, P, int_to_limbs
 
-TILE_M = 128  # batch elements per grid step
+TILE_T = 512  # batch elements (lanes) per grid step
 
-_COLS = 2 * N_LIMBS  # 96
+_ROWS = 2 * N_LIMBS  # 96 product rows
 
-
-def _build_constants():
-    n = N_LIMBS
-    rep = np.zeros((n, n * n), np.float32)   # a limb i -> lanes i*48+j
-    til = np.zeros((n, n * n), np.float32)   # b limb j -> lanes i*48+j
-    conv = np.zeros((n * n, _COLS), np.float32)
-    for i in range(n):
-        for j in range(n):
-            rep[i, i * n + j] = 1.0
-            til[j, i * n + j] = 1.0
-            conv[i * n + j, i + j] = 1.0
-    p_limbs = int_to_limbs(P)
-    pshift = np.zeros((n, _COLS), np.int32)  # row i = p << (8*i), per-limb
-    for i in range(n):
-        pshift[i, i:i + n] = p_limbs
-    return rep, til, conv, pshift
+_P_COL = np.asarray(int_to_limbs(P)).reshape(N_LIMBS, 1)
+_P0 = int(_P_COL[0, 0])
 
 
-_REP, _TIL, _CONV, _PSHIFT = _build_constants()
-_P0 = int(_PSHIFT[0, 0])  # lowest limb of p
+def _mont_mul_kernel(a_ref, b_ref, p_ref, out_ref, t_ref):
+    p_col = p_ref[:]                                   # [48, 1]
+    b_all = b_ref[:]                                   # [48, T]
 
+    # schoolbook convolution into the 96 digit rows (static windows)
+    t_ref[0:N_LIMBS, :] = b_all * a_ref[0, :][None, :]
+    t_ref[N_LIMBS:_ROWS, :] = jnp.zeros_like(t_ref[N_LIMBS:_ROWS, :])
+    for i in range(1, N_LIMBS):
+        t_ref[i:i + N_LIMBS, :] += b_all * a_ref[i, :][None, :]
 
-def _mont_mul_kernel(a_ref, b_ref, rep_ref, til_ref, conv_ref, pshift_ref,
-                     out_ref):
-    hi = jax.lax.Precision.HIGHEST
-    dn = (((1,), (0,)), ((), ()))
-    af = a_ref[:].astype(jnp.float32)
-    bf = b_ref[:].astype(jnp.float32)
-    a_rep = jax.lax.dot_general(af, rep_ref[:], dn, precision=hi,
-                                preferred_element_type=jnp.float32)
-    b_til = jax.lax.dot_general(bf, til_ref[:], dn, precision=hi,
-                                preferred_element_type=jnp.float32)
-    outer = a_rep * b_til
-    t = jax.lax.dot_general(outer, conv_ref[:], dn, precision=hi,
-                            preferred_element_type=jnp.float32)
-    t = jnp.round(t).astype(jnp.int32)  # exact integers ≤ 2^22
+    # CIOS fold: one digit per step, division by R row-by-row
+    for i in range(N_LIMBS):
+        trow = t_ref[i, :]
+        m = (trow * NINV8) & LIMB_MASK                 # int32 wrap keeps low 8
+        t_ref[i:i + N_LIMBS, :] += p_col * m[None, :]
+        t_ref[i + 1, :] += (trow + m * _P0) >> LIMB_BITS
 
-    col96 = jax.lax.broadcasted_iota(jnp.int32, (1, _COLS), 1)
-    row48 = jax.lax.broadcasted_iota(jnp.int32, (N_LIMBS, 1), 0)
-    pshift = pshift_ref[:]
-
-    def fold(i, t):
-        # digit-wise Montgomery reduction, division by R done by
-        # consuming (zeroing) one column per step
-        tcol = jnp.sum(jnp.where(col96 == i, t, 0), axis=1)       # [T]
-        m = (tcol * NINV8) & LIMB_MASK
-        prow = jnp.sum(jnp.where(row48 == i, pshift, 0), axis=0)  # [96]
-        t = t + m[:, None] * prow[None, :]
-        carry = (tcol + m * _P0) >> LIMB_BITS
-        t = t + jnp.where(col96 == i + 1, 1, 0) * carry[:, None]
-        return jnp.where(col96 == i, 0, t)
-
-    t = jax.lax.fori_loop(0, N_LIMBS, fold, t)
-
-    col48 = jax.lax.broadcasted_iota(jnp.int32, (1, N_LIMBS), 1)
-
-    def norm(k, state):
-        res, c = state
-        v = jnp.sum(jnp.where(col96 == N_LIMBS + k, t, 0), axis=1) + c
-        res = res + jnp.where(col48 == k, 1, 0) * (v & LIMB_MASK)[:, None]
-        return res, v >> LIMB_BITS
-
-    res, _ = jax.lax.fori_loop(
-        0, N_LIMBS, norm,
-        (jnp.zeros(out_ref.shape, jnp.int32),
-         jnp.zeros((out_ref.shape[0],), jnp.int32)),
-    )
-    out_ref[:] = res
+    # carry-normalize rows 48..95 into the output tile
+    carry = jnp.zeros_like(t_ref[0, :])
+    for k in range(N_LIMBS):
+        v = t_ref[N_LIMBS + k, :] + carry
+        out_ref[k, :] = v & LIMB_MASK
+        carry = v >> LIMB_BITS
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _mont_mul_flat(a, b, interpret: bool = False):
+    """a, b: int32[M, 48] → int32[M, 48] (transposition handled here)."""
     m = a.shape[0]
-    m_pad = -(-m // TILE_M) * TILE_M
+    # small batches get a lane-width tile instead of padding to TILE_T
+    tile = min(TILE_T, max(128, -(-m // 128) * 128))
+    m_pad = -(-m // tile) * tile
+    at = jnp.transpose(a)
+    bt = jnp.transpose(b)
     if m_pad != m:
-        pad = ((0, m_pad - m), (0, 0))
-        a = jnp.pad(a, pad)
-        b = jnp.pad(b, pad)
+        pad = ((0, 0), (0, m_pad - m))
+        at = jnp.pad(at, pad)
+        bt = jnp.pad(bt, pad)
 
-    batch_spec = pl.BlockSpec((TILE_M, N_LIMBS), lambda i: (i, 0))
-    const = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))
+    spec_in = pl.BlockSpec((N_LIMBS, tile), lambda i: (0, i))
     out = pl.pallas_call(
         _mont_mul_kernel,
-        out_shape=jax.ShapeDtypeStruct((m_pad, N_LIMBS), jnp.int32),
-        grid=(m_pad // TILE_M,),
-        in_specs=[
-            batch_spec, batch_spec,
-            const(_REP.shape), const(_TIL.shape),
-            const(_CONV.shape), const(_PSHIFT.shape),
-        ],
-        out_specs=batch_spec,
+        out_shape=jax.ShapeDtypeStruct((N_LIMBS, m_pad), jnp.int32),
+        grid=(m_pad // tile,),
+        in_specs=[spec_in, spec_in,
+                  pl.BlockSpec((N_LIMBS, 1), lambda i: (0, 0))],
+        out_specs=spec_in,
+        scratch_shapes=[pltpu.VMEM((_ROWS, tile), jnp.int32)],
         interpret=interpret,
-    )(a, b, jnp.asarray(_REP), jnp.asarray(_TIL), jnp.asarray(_CONV),
-      jnp.asarray(_PSHIFT))
-    return out[:m] if m_pad != m else out
+    )(at, bt, jnp.asarray(_P_COL))
+    return jnp.transpose(out[:, :m] if m_pad != m else out)
 
 
 def mont_mul_pallas(a, b):

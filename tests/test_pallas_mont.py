@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from lighthouse_tpu.ops import limb
-from lighthouse_tpu.ops.pallas_mont import TILE_M, mont_mul_pallas
+from lighthouse_tpu.ops.pallas_mont import TILE_T, mont_mul_pallas
 
 
 def _rand_elems(rng, n):
@@ -34,7 +34,7 @@ class TestPallasMontMul:
 
     def test_matches_xla_path_batch(self):
         rng = random.Random(12)
-        n = TILE_M + 17  # forces padding + a second tile
+        n = TILE_T + 17  # forces padding + a second tile
         a = _rand_elems(rng, n)
         b = _rand_elems(rng, n)
         want = np.asarray(limb.mont_mul(a, b))
