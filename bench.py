@@ -52,17 +52,28 @@ def main() -> None:
     )
     from lighthouse_tpu.crypto.bls.curve import g2_infinity
     from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
-    from lighthouse_tpu.jax_backend import _rand_bits_array, _verify_jit
+    from lighthouse_tpu.jax_backend import (
+        _rand_bits_array,
+        _verify_fused_jit,
+        _verify_jit,
+    )
+
+    # The fused Pallas-kernel verifier (ops/tkernel*.py) is the
+    # production TPU path: ~3-5x the classic XLA program. Off-TPU it
+    # would run in interpreter mode (minutes per call), so the classic
+    # path stays the default there. BENCH_FUSED=0/1 overrides.
+    fused_choice = os.environ.get("BENCH_FUSED")
+    if fused_choice is None:
+        fused_choice = "1" if jax.default_backend() == "tpu" else "0"
+    _verify = _verify_fused_jit if fused_choice == "1" else _verify_jit
     from lighthouse_tpu.ops.points import g1_to_dev, g2_to_dev
 
     quick = "--quick" in sys.argv
-    # Default batch 2048: the verify program is latency-bound (measured on
-    # v5e: 2.3s at S=64, 5.6s at S=512 ≈ 91 sets/s, 16.0s at S=2048 ≈ 128
-    # sets/s), so
-    # throughput scales with batch size — 2048 measured ~40% over 512 and
-    # its compile is already in the persistent cache on this host. The
-    # gossip-batch workload (BASELINE config #4) accumulates batches this
-    # size and larger.
+    # Default batch 2048. Fused-path v5e measurements: 0.53s at S=64
+    # (121 sets/s), 1.47s at S=512 (350 sets/s), 4.94s at S=2048
+    # (415 sets/s) — vs the classic XLA program's 2.3s / 5.6s / 16.0s.
+    # Throughput still grows with batch; 2048 bounds compile time and
+    # matches the gossip-batch accumulation size (BASELINE config #4).
     S = int(os.environ.get("BENCH_SETS", "4" if quick else "2048"))
     REPS = int(os.environ.get("BENCH_REPS", "1" if quick else "2"))
     BASELINE_SETS = int(os.environ.get("BENCH_BASELINE_SETS", "2" if quick else "4"))
@@ -89,11 +100,11 @@ def main() -> None:
     )
 
     # --- exactness gate on this device (incl. compile/warmup) --------------
-    ok = bool(_verify_jit(*dev_args))
+    ok = bool(_verify(*dev_args))
     bad_sy = np.array(sy)
     bad_sy[0] = sy[(1 if S > 1 else 0)]  # swap in a mismatched signature
     bad = bool(
-        _verify_jit(
+        _verify(
             dev_args[0], dev_args[1],
             (jnp.asarray(sx), jnp.asarray(bad_sy)), dev_args[3],
             dev_args[4], dev_args[5], dev_args[6],
@@ -108,7 +119,7 @@ def main() -> None:
     # --- timed region -------------------------------------------------------
     t0 = time.perf_counter()
     for _ in range(REPS):
-        bool(_verify_jit(*dev_args))
+        bool(_verify(*dev_args))
     dt = (time.perf_counter() - t0) / REPS
     dev_sets_per_sec = S / dt
 

@@ -43,6 +43,66 @@ BUILT_IN: dict[str, dict] = {
         "DEPOSIT_CHAIN_ID": 5,
         "boot_enr": [],
     },
+    "gnosis": {
+        "PRESET_BASE": "gnosis",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 4096,
+        "MIN_GENESIS_TIME": 1638968400,
+        "GENESIS_DELAY": 6000,
+        "GENESIS_FORK_VERSION": "0x00000064",
+        "ALTAIR_FORK_VERSION": "0x01000064",
+        "ALTAIR_FORK_EPOCH": 512,
+        "BELLATRIX_FORK_VERSION": "0x02000064",
+        "BELLATRIX_FORK_EPOCH": 385536,
+        "SECONDS_PER_SLOT": 5,
+        "ETH1_FOLLOW_DISTANCE": 1024,
+        "DEPOSIT_CHAIN_ID": 100,
+        "boot_enr": [],
+    },
+    "sepolia": {
+        "PRESET_BASE": "mainnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 1300,
+        "MIN_GENESIS_TIME": 1655647200,
+        "GENESIS_DELAY": 86400,
+        "GENESIS_FORK_VERSION": "0x90000069",
+        "ALTAIR_FORK_VERSION": "0x90000070",
+        "ALTAIR_FORK_EPOCH": 50,
+        "BELLATRIX_FORK_VERSION": "0x90000071",
+        "BELLATRIX_FORK_EPOCH": 100,
+        "SECONDS_PER_SLOT": 12,
+        "ETH1_FOLLOW_DISTANCE": 2048,
+        "DEPOSIT_CHAIN_ID": 11155111,
+        "boot_enr": [],
+    },
+    "ropsten": {
+        "PRESET_BASE": "mainnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 100000,
+        "MIN_GENESIS_TIME": 1653318000,
+        "GENESIS_DELAY": 604800,
+        "GENESIS_FORK_VERSION": "0x80000069",
+        "ALTAIR_FORK_VERSION": "0x80000070",
+        "ALTAIR_FORK_EPOCH": 500,
+        "BELLATRIX_FORK_VERSION": "0x80000071",
+        "BELLATRIX_FORK_EPOCH": 750,
+        "SECONDS_PER_SLOT": 12,
+        "ETH1_FOLLOW_DISTANCE": 2048,
+        "DEPOSIT_CHAIN_ID": 3,
+        "boot_enr": [],
+    },
+    "kiln": {
+        "PRESET_BASE": "mainnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 95000,
+        "MIN_GENESIS_TIME": 1647007200,
+        "GENESIS_DELAY": 300,
+        "GENESIS_FORK_VERSION": "0x70000069",
+        "ALTAIR_FORK_VERSION": "0x70000070",
+        "ALTAIR_FORK_EPOCH": 50,
+        "BELLATRIX_FORK_VERSION": "0x70000071",
+        "BELLATRIX_FORK_EPOCH": 150,
+        "SECONDS_PER_SLOT": 12,
+        "ETH1_FOLLOW_DISTANCE": 2048,
+        "DEPOSIT_CHAIN_ID": 1337802,
+        "boot_enr": [],
+    },
     "minimal-interop": {
         "PRESET_BASE": "minimal",
         "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 64,
@@ -65,11 +125,23 @@ def spec_for_network(name: str) -> ChainSpec:
     if cfg is None:
         raise KeyError(f"unknown network {name!r}; have {sorted(BUILT_IN)}")
     base = minimal_spec() if cfg["PRESET_BASE"] == "minimal" else mainnet_spec()
+    if cfg["PRESET_BASE"] == "gnosis":
+        # Gnosis runs its own preset (eth_spec.rs gnosis feature):
+        # 16-slot epochs and a 512-epoch sync-committee period on
+        # otherwise-mainnet sizes.
+        base = dataclasses.replace(
+            base,
+            preset=dataclasses.replace(
+                base.preset,
+                SLOTS_PER_EPOCH=16,
+                EPOCHS_PER_SYNC_COMMITTEE_PERIOD=512,
+            ),
+        )
     updates: dict = {"name": name}
     for key in (
         "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT", "MIN_GENESIS_TIME",
         "GENESIS_DELAY", "SECONDS_PER_SLOT", "ETH1_FOLLOW_DISTANCE",
-        "ALTAIR_FORK_EPOCH", "BELLATRIX_FORK_EPOCH",
+        "ALTAIR_FORK_EPOCH", "BELLATRIX_FORK_EPOCH", "DEPOSIT_CHAIN_ID",
     ):
         if key in cfg and hasattr(base, key):
             updates[key] = cfg[key]

@@ -128,6 +128,95 @@ def _verify_core(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
 _verify_jit = jax.jit(_verify_core)
 
 
+def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
+    """Fused-kernel variant of :func:`_verify_core` (same contract).
+
+    The long sequential chains (to-affine inversions, RLC scalar muls,
+    subgroup checks, Miller loops, final exponentiation) each run as ONE
+    Pallas program (ops/tkernel_calls.py) — loop iterations in-kernel
+    cost ~μs vs ~0.1-1ms per XLA-level op, which is what bounds
+    _verify_core's wall time. Log-depth glue (aggregation/product trees,
+    concatenation) stays in XLA. Verified bit-equivalent to
+    _verify_core; both paths share the host-side assembly in JaxBackend.
+    """
+    from .ops import tkernel as tk
+    from .ops import tkernel_calls as tc
+    from .ops.pairing import fp12_tree_prod
+
+    S, K = pk_inf.shape
+
+    def mask_row(m):
+        return m[None, :].astype(jnp.int32)
+
+    # Per-set pubkey aggregation (log2 K tree, XLA).
+    pk_j = pt_from_affine(FP_OPS, pk[0], pk[1], pk_inf)
+    agg = pt_tree_sum_axis(FP_OPS, pk_j, axis=1, axis_size=K)  # [S]
+
+    # Affine-normalize the aggregates in one inversion kernel.
+    agg_t = tuple(tk.batch_to_t(c) for c in agg)
+    ax, ay, ainf = tc.to_affine_g1_t(agg_t)
+
+    # RLC scalar muls (64-step chains -> kernels).
+    bits_t = jnp.transpose(r_bits)                       # [64, S]
+    sig_t = (tk.batch_to_t(sig[0]), tk.batch_to_t(sig[1]))
+    rpk = tc.scalar_mul_g1_t(ax, ay, mask_row(ainf), bits_t)
+    rsig = tc.scalar_mul_g2_t(sig_t[0], sig_t[1], mask_row(sig_inf), bits_t)
+
+    # Signature subgroup membership (255-step chain -> kernel).
+    sub_ok = jnp.all(
+        tc.subgroup_check_g2_t(sig_t[0], sig_t[1], mask_row(sig_inf))
+    )
+
+    # sum_i [r_i] sig_i (log2 S tree, XLA) then one affine kernel.
+    rsig_c = tuple(tk.batch_from_t(c) for c in rsig)
+    sig_acc = pt_tree_sum(FP2_OPS, rsig_c, S)
+    sig_acc_t = tuple(tk.batch_to_t(c[None]) for c in sig_acc)
+    sax, say, sainf = tc.to_affine_g2_t(sig_acc_t)
+
+    rx, ry, rinf = tc.to_affine_g1_t(rpk)
+
+    # Multi-pairing operand assembly (lane concat, padded to 2^m).
+    neg_g1 = (G1_GEN_DEV[0][:, None], limb.neg(G1_GEN_DEV[1])[:, None])
+    g1_x = jnp.concatenate([rx, neg_g1[0]], axis=-1)
+    g1_y = jnp.concatenate([ry, neg_g1[1]], axis=-1)
+    g1_inf = jnp.concatenate([rinf, jnp.zeros((1,), bool)])
+    msg_t = (tk.batch_to_t(msg[0]), tk.batch_to_t(msg[1]))
+    g2_x = jnp.concatenate([msg_t[0], sax], axis=-1)
+    g2_y = jnp.concatenate([msg_t[1], say], axis=-1)
+    g2_inf = jnp.concatenate([msg_inf, sainf])
+
+    M = _next_pow2(S + 1)
+    pad = M - (S + 1)
+    if pad:
+        g1_x = jnp.concatenate(
+            [g1_x, jnp.broadcast_to(g1_x[..., -1:], (48, pad))], axis=-1
+        )
+        g1_y = jnp.concatenate(
+            [g1_y, jnp.broadcast_to(g1_y[..., -1:], (48, pad))], axis=-1
+        )
+        g1_inf = jnp.concatenate([g1_inf, jnp.ones((pad,), bool)])
+        g2_x = jnp.concatenate(
+            [g2_x, jnp.broadcast_to(g2_x[..., -1:], (2, 48, pad))], axis=-1
+        )
+        g2_y = jnp.concatenate(
+            [g2_y, jnp.broadcast_to(g2_y[..., -1:], (2, 48, pad))], axis=-1
+        )
+        g2_inf = jnp.concatenate([g2_inf, jnp.ones((pad,), bool)])
+
+    f = tc.miller_loop_kernel_t((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf)
+
+    # Product tree over the M pair lanes (log2 M, XLA, classic layout).
+    f_c = tk.batch_from_t(f)
+    f1 = fp12_tree_prod(f_c, M)
+
+    # Final exponentiation (≈1000-step chain -> kernel, single lane).
+    fe = tc.final_exp_kernel_t(tk.batch_to_t(f1[None]))
+    return tower.fp12_is_one(tk.batch_from_t(fe)[0]) & sub_ok
+
+
+_verify_fused_jit = jax.jit(_verify_core_fused)
+
+
 def _rand_bits_array(n: int) -> np.ndarray:
     """n nonzero RAND_BITS-bit scalars as an MSB-first bit tensor."""
     out = np.zeros((n, RAND_BITS), np.int32)
@@ -187,7 +276,18 @@ class JaxBackend:
 
         r_bits = _rand_bits_array(S)
 
-        ok = _verify_jit(
+        import os
+
+        # Fused Pallas kernels are the production path on TPU (3-5x the
+        # classic XLA program, see ops/tkernel*.py); the classic path
+        # stays default off-TPU where Mosaic isn't available and the
+        # interpreter's compile cost dominates. LHTPU_FUSED_VERIFY=0/1
+        # overrides.
+        choice = os.environ.get("LHTPU_FUSED_VERIFY")
+        if choice is None:
+            choice = "1" if jax.default_backend() == "tpu" else "0"
+        fn = _verify_fused_jit if choice == "1" else _verify_jit
+        ok = fn(
             (jnp.asarray(px), jnp.asarray(py)),
             jnp.asarray(pinf),
             (jnp.asarray(sx), jnp.asarray(sy)),

@@ -1,0 +1,589 @@
+"""Transposed ("limbs on sublanes, batch on lanes") field library for the
+fused Pallas verifier kernels.
+
+Why this exists: profiling on the v5e (see pallas_mont.py history) shows
+per-XLA-op dispatch overhead of ~0.1-1ms dominating the batch verifier —
+the arithmetic itself is nearly free. The verifier's wall time is its
+*sequential depth* (64-step RLC scalar muls, ~255-step subgroup checks,
+63-step Miller loop, ~1000-step final-exp/inversion chains) times that
+per-op overhead. The fix (ops/tkernel_calls.py) runs each long chain
+inside ONE Pallas program, where a loop iteration costs ~μs instead of
+~ms. This module is the arithmetic those programs are built from.
+
+Layout: every Fp element is int32[..., 48, T] — limb axis on sublanes,
+batch on lanes — so limb-window operations are static sublane slices.
+Coefficient/stack axes sit ahead of the limb axis exactly like
+ops/tower.py (Fp2 = [..., 2, 48, T], Fp6 = [..., 3, 2, 48, T],
+Fp12 = [..., 2, 3, 2, 48, T]). All functions are plain jnp compositions,
+usable both inside Pallas kernels and directly under XLA (tests exploit
+this: transposed results are compared against ops/limb.py / ops/tower.py
+bit-for-bit).
+
+The group law is NOT re-implemented: ops/points.py is generic over a
+FieldOps namespace and :class:`TFieldOps` adapts the transposed layout
+(lane masks broadcast from the right, so select needs no axis padding).
+
+Constants discipline: Pallas kernels may not close over array constants —
+every constant must arrive as a kernel input. All field constants here
+live in one ``CONSTS`` bundle (int32[N_CONSTS, 48, 1]); XLA-land callers
+use the module default, kernel bodies rebind via ``bound_consts(c)``
+around the traced body (trace-time thread-local swap).
+
+Semantics/invariants mirror ops/limb.py exactly: Montgomery form, lazy
+[0, 2p) domain, limbs normalized to [0, 255] on op exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.constants import P
+from . import limb as _limb
+from .limb import LIMB_BITS, LIMB_MASK, N_LIMBS, NINV8
+from .points import FieldOps
+
+_ROWS = 2 * N_LIMBS
+
+# ----------------------------------------------------------- const bundle
+# Row order in the CONSTS bundle. Fp2 constants occupy two consecutive
+# rows (c0, c1).
+_IDX = {
+    "P": 0,
+    "TWO_P": 1,
+    "R": 2,        # 1 in Montgomery form
+    "ZERO": 3,
+    "FROB6_C1": 4,     # Fp2: rows 4-5
+    "FROB6_C2": 6,     # rows 6-7
+    "FROB12_C1": 8,    # rows 8-9
+}
+N_CONSTS = 10
+
+
+def _build_consts() -> np.ndarray:
+    from . import tower
+
+    c = np.zeros((N_CONSTS, N_LIMBS, 1), np.int32)
+
+    def put(name, limbs):
+        c[_IDX[name], :, 0] = np.asarray(limbs)
+
+    put("P", _limb.int_to_limbs(P))
+    put("TWO_P", _limb.int_to_limbs(2 * P))
+    put("R", _limb.int_to_limbs(_limb.R_MONT))
+    for name in ("FROB6_C1", "FROB6_C2", "FROB12_C1"):
+        pair = np.asarray(getattr(tower, name))  # [2, 48] lane-limb layout
+        c[_IDX[name], :, 0] = pair[0]
+        c[_IDX[name] + 1, :, 0] = pair[1]
+    return c
+
+
+CONSTS_NP = _build_consts()
+_P0 = int(CONSTS_NP[_IDX["P"], 0, 0])
+
+# Current bindings (trace-time, thread-local: concurrent jit traces must
+# not see each other's kernel refs). Slots: bundle, pinv_bits, lowmem —
+# pinv_bits may be a ref inside kernels; lowmem=True makes fp6/fp12
+# products loop instead of stacking beyond the fp2 level (VMEM: a
+# fully-stacked fp12 product needs a [54, 96, T] Montgomery buffer —
+# 8.5 MB at T=128 — which blows the 16 MB budget inside kernels; under
+# XLA the stacking is what amortizes dispatches).
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def _cur() -> list:
+    if not hasattr(_TLS, "cur"):
+        _TLS.cur = [None, None, False]
+    return _TLS.cur
+
+
+def _bundle():
+    cur = _cur()
+    if cur[0] is None:
+        cur[0] = jnp.asarray(CONSTS_NP)
+    return cur[0]
+
+
+def _pinv_bits():
+    cur = _cur()
+    if cur[1] is None:
+        cur[1] = jnp.asarray(PINV_BITS_NP.reshape(-1, 1))
+    return cur[1]
+
+
+@contextlib.contextmanager
+def bound_consts(bundle, pinv_bits=None, lowmem=False):
+    """Rebind the constant bundle (and optionally the inversion bit
+    table / low-memory mode) for the duration of a traced region —
+    kernel bodies pass their consts input values/refs here."""
+    cur = _cur()
+    prev = cur[:]
+    cur[0] = bundle
+    if pinv_bits is not None:
+        cur[1] = pinv_bits
+    cur[2] = lowmem
+    try:
+        yield
+    finally:
+        cur[:] = prev
+
+
+def _lowmem() -> bool:
+    return _cur()[2]
+
+
+def _c(name):
+    return _bundle()[_IDX[name]]
+
+
+def _c2(name):
+    i = _IDX[name]
+    return _bundle()[i:i + 2]
+
+
+# -------------------------------------------------------- layout helpers
+
+
+def batch_to_t(a):
+    """[B, ..., 48] -> [..., 48, B]: leading batch axis becomes lanes."""
+    return jnp.moveaxis(jnp.asarray(a), 0, -1)
+
+
+def batch_from_t(a):
+    """[..., 48, B] -> [B, ..., 48]."""
+    return jnp.moveaxis(jnp.asarray(a), -1, 0)
+
+
+# ------------------------------------------------------------- carry logic
+
+
+def _carry_norm(t):
+    """Full carry propagation over the limb axis (-2). Signed inputs OK
+    (arithmetic shift); returns (normalized limbs, carry_out[...]).
+
+    Scan-with-roll structure (mirroring limb._carry_scan): static row-0
+    access per step keeps the traced graph ~5 ops instead of ~200 — the
+    unrolled form made XLA-CPU compiles of kernel bodies pathological."""
+    rows = t.shape[-2]
+
+    def step(_, carry):
+        t, c = carry
+        v = t[..., 0, :] + c
+        # rotate-by-concat (no .at/roll: Mosaic lowers neither scatter
+        # nor scan in kernels; fori_loop + concatenate it can)
+        t = jnp.concatenate(
+            [t[..., 1:, :], (v & LIMB_MASK)[..., None, :]], axis=-2
+        )
+        return (t, v >> LIMB_BITS)
+
+    t, c = jax.lax.fori_loop(
+        0, rows, step, (t, jnp.zeros_like(t[..., 0, :]))
+    )
+    return t, c  # rows rotated full circle: original order
+
+
+def add_t(a, b):
+    """(a + b) mod-ish, in [0, 2p) (limb.add semantics)."""
+    s, _ = _carry_norm(a + b)
+    d, borrow = _carry_norm(s - _c("TWO_P"))
+    return jnp.where((borrow == 0)[..., None, :], d, s)
+
+
+def sub_t(a, b):
+    d2, borrow = _carry_norm(a - b)
+    d1, _ = _carry_norm(a - b + _c("TWO_P"))
+    return jnp.where((borrow == 0)[..., None, :], d2, d1)
+
+
+def neg_t(a):
+    return sub_t(jnp.zeros_like(a), a)
+
+
+def double_t(a):
+    return add_t(a, a)
+
+
+def mont_mul_t(a, b):
+    """Montgomery product on the transposed layout; broadcast over leading
+    axes. Schoolbook conv + CIOS fold + carry, all as scan-with-roll so
+    the traced graph stays compact (see _carry_norm note); this is the
+    classic limb.mont_mul schedule with the limb axis moved to -2."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    p_col = _c("P")
+    zero_rows = jnp.zeros((*shape[:-2], N_LIMBS, shape[-1]), jnp.int32)
+    b96 = jnp.concatenate([b, jnp.zeros_like(b)], axis=-2)
+
+    def conv_step(_, carry):
+        t, a_buf, b_buf = carry
+        t = t + b_buf * a_buf[..., 0:1, :]
+        a_buf = jnp.concatenate(
+            [a_buf[..., 1:, :], a_buf[..., :1, :]], axis=-2
+        )
+        b_buf = jnp.concatenate(
+            [b_buf[..., -1:, :], b_buf[..., :-1, :]], axis=-2
+        )
+        return (t, a_buf, b_buf)
+
+    t, _, _ = jax.lax.fori_loop(
+        0, N_LIMBS, conv_step,
+        (jnp.concatenate([zero_rows, zero_rows], axis=-2), a, b96),
+    )
+
+    def fold_step(_, t):
+        m = (t[..., 0, :] * NINV8) & LIMB_MASK
+        head = t[..., :N_LIMBS, :] + p_col * m[..., None, :]
+        carry = head[..., 0, :] >> LIMB_BITS
+        row1 = head[..., 1:2, :] + carry[..., None, :]
+        # consumed row 0 drops off; fresh zero row enters at the top —
+        # the roll fused into the concat
+        return jnp.concatenate(
+            [row1, head[..., 2:, :], t[..., N_LIMBS:, :],
+             jnp.zeros_like(row1)],
+            axis=-2,
+        )
+
+    t = jax.lax.fori_loop(0, N_LIMBS, fold_step, t)
+    out, _ = _carry_norm(t[..., :N_LIMBS, :])
+    return out
+
+
+def mont_sqr_t(a):
+    return mont_mul_t(a, a)
+
+
+def bits_msb_first(e: int) -> np.ndarray:
+    return np.asarray([int(b) for b in bin(e)[2:]], np.int32)
+
+
+# Bits of p-2 (Fermat inversion exponent), MSB first.
+PINV_BITS_NP = bits_msb_first(P - 2)
+PINV_NBITS = len(PINV_BITS_NP)
+
+
+def pow_bits_t(a, bit_src, nbits: int):
+    """a^e by square-and-multiply; ``bit_src`` is indexable int32 bits of
+    e MSB-first — an [n, 1] column — jnp array (XLA-land) or kernel input ref
+    (values don't support dynamic indexing under Mosaic; refs do).
+    fori_loop keeps the traced body single-copy; the leading bit consumes
+    ``a`` itself."""
+
+    def body(i, acc):
+        acc = mont_sqr_t(acc)
+        return jnp.where(bit_src[i, 0] == 1, mont_mul_t(acc, a), acc)
+
+    return jax.lax.fori_loop(1, nbits, body, a)
+
+
+def mont_inv_t(a):
+    """Fermat inversion a^(p-2); 0 -> 0 (limb.mont_inv semantics)."""
+    return pow_bits_t(a, _pinv_bits(), PINV_NBITS)
+
+
+def canonical_t(a):
+    """Reduce [0,2p) -> [0,p) for comparisons (limb.canonical)."""
+    d, borrow = _carry_norm(a - _c("P"))
+    return jnp.where((borrow == 0)[..., None, :], d, a)
+
+
+def is_zero_t(a):
+    return jnp.all(canonical_t(a) == 0, axis=-2)
+
+
+def eq_t(a, b):
+    return jnp.all(canonical_t(a) == canonical_t(b), axis=-2)
+
+
+# ------------------------------------------------------------------- Fp2
+
+
+def _stk(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+fp2_add_t = add_t
+fp2_sub_t = sub_t
+fp2_neg_t = neg_t
+fp2_double_t = double_t
+
+
+def fp2_mul_t(a, b):
+    """Karatsuba, one stacked mont_mul (tower.fp2_mul transposed)."""
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
+    t = mont_mul_t(
+        _stk([a0, a1, add_t(a0, a1)], -3),
+        _stk([b0, b1, add_t(b0, b1)], -3),
+    )
+    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    return _stk([sub_t(t0, t1), sub_t(sub_t(t2, t0), t1)], -3)
+
+
+def fp2_sqr_t(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    t = mont_mul_t(
+        _stk([add_t(a0, a1), a0], -3),
+        _stk([sub_t(a0, a1), a1], -3),
+    )
+    return _stk([t[..., 0, :, :], double_t(t[..., 1, :, :])], -3)
+
+
+def fp2_mul_fp_t(a, k):
+    return mont_mul_t(a, k[..., None, :, :])
+
+
+def fp2_mul_by_xi_t(a):
+    a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
+    return _stk([sub_t(a0, a1), add_t(a0, a1)], -3)
+
+
+def fp2_conj_t(a):
+    return _stk([a[..., 0, :, :], neg_t(a[..., 1, :, :])], -3)
+
+
+def fp2_triple_t(a):
+    return add_t(double_t(a), a)
+
+
+def fp2_inv_t(a):
+    s = mont_mul_t(a, a)
+    norm_inv = mont_inv_t(add_t(s[..., 0, :, :], s[..., 1, :, :]))
+    return _stk(
+        [
+            mont_mul_t(a[..., 0, :, :], norm_inv),
+            mont_mul_t(neg_t(a[..., 1, :, :]), norm_inv),
+        ],
+        -3,
+    )
+
+
+def fp2_is_zero_t(a):
+    return is_zero_t(a[..., 0, :, :]) & is_zero_t(a[..., 1, :, :])
+
+
+def fp2_eq_t(a, b):
+    return eq_t(a[..., 0, :, :], b[..., 0, :, :]) & eq_t(
+        a[..., 1, :, :], b[..., 1, :, :]
+    )
+
+
+# --------------------------------------------------------------------- Fp6
+
+
+def _f6(a, i):
+    return a[..., i, :, :, :]
+
+
+def fp6_mul_t(a, b):
+    """Toom/Karatsuba 6-product schedule (tower.fp6_mul transposed)."""
+    a0, a1, a2 = (_f6(a, i) for i in range(3))
+    b0, b1, b2 = (_f6(b, i) for i in range(3))
+    pairs = [
+        (a0, b0), (a1, b1), (a2, b2),
+        (add_t(a1, a2), add_t(b1, b2)),
+        (add_t(a0, a1), add_t(b0, b1)),
+        (add_t(a0, a2), add_t(b0, b2)),
+    ]
+    if _lowmem():
+        t0, t1, t2, s12, s01, s02 = (fp2_mul_t(x, y) for x, y in pairs)
+    else:
+        t = fp2_mul_t(
+            _stk([x for x, _ in pairs], -4), _stk([y for _, y in pairs], -4)
+        )
+        t0, t1, t2, s12, s01, s02 = (t[..., i, :, :, :] for i in range(6))
+    c0 = add_t(fp2_mul_by_xi_t(sub_t(sub_t(s12, t1), t2)), t0)
+    c1 = add_t(sub_t(sub_t(s01, t0), t1), fp2_mul_by_xi_t(t2))
+    c2 = add_t(sub_t(sub_t(s02, t0), t2), t1)
+    return _stk([c0, c1, c2], -4)
+
+
+def fp6_neg_t(a):
+    return neg_t(a)
+
+
+def fp6_mul_by_v_t(a):
+    return _stk([fp2_mul_by_xi_t(_f6(a, 2)), _f6(a, 0), _f6(a, 1)], -4)
+
+
+def fp6_mul_fp2_t(a, k):
+    return fp2_mul_t(a, k[..., None, :, :, :])
+
+
+def fp6_inv_t(a):
+    c0, c1, c2 = (_f6(a, i) for i in range(3))
+    mp = [(c0, c0), (c1, c2), (c2, c2), (c0, c1), (c1, c1), (c0, c2)]
+    if _lowmem():
+        a_sq, bc, c_sq, ab, b_sq, ac = (fp2_mul_t(x, y) for x, y in mp)
+    else:
+        m = fp2_mul_t(
+            _stk([x for x, _ in mp], -4), _stk([y for _, y in mp], -4)
+        )
+        a_sq, bc, c_sq, ab, b_sq, ac = (m[..., i, :, :, :] for i in range(6))
+    t0 = sub_t(a_sq, fp2_mul_by_xi_t(bc))
+    t1 = sub_t(fp2_mul_by_xi_t(c_sq), ab)
+    t2 = sub_t(b_sq, ac)
+    if _lowmem():
+        n0, n1, n2 = (fp2_mul_t(x, y)
+                      for x, y in [(c0, t0), (c2, t1), (c1, t2)])
+    else:
+        n = fp2_mul_t(_stk([c0, c2, c1], -4), _stk([t0, t1, t2], -4))
+        n0, n1, n2 = (n[..., i, :, :, :] for i in range(3))
+    denom = add_t(n0, fp2_mul_by_xi_t(add_t(n1, n2)))
+    d_inv = fp2_inv_t(denom)
+    if _lowmem():
+        return _stk([fp2_mul_t(x, d_inv) for x in (t0, t1, t2)], -4)
+    return fp2_mul_t(_stk([t0, t1, t2], -4), d_inv[..., None, :, :, :])
+
+
+def fp6_frobenius_t(a):
+    c = fp2_conj_t(a)
+    return _stk(
+        [
+            c[..., 0, :, :, :],
+            fp2_mul_t(c[..., 1, :, :, :], _c2("FROB6_C1")),
+            fp2_mul_t(c[..., 2, :, :, :], _c2("FROB6_C2")),
+        ],
+        -4,
+    )
+
+
+# -------------------------------------------------------------------- Fp12
+
+
+def _w(a, i):
+    return a[..., i, :, :, :, :]
+
+
+def fp12_one_t(shape_like):
+    """Fp12 one broadcast to a batch: shape_like is any [.., 48, T] Fp."""
+    lanes = shape_like.shape[-1]
+    one = jnp.broadcast_to(_c("R"), (N_LIMBS, lanes))
+    zero = jnp.zeros((N_LIMBS, lanes), jnp.int32)
+
+    def f2(x0, x1):
+        return _stk([x0, x1], -3)
+
+    def f6(a, b, c):
+        return _stk([a, b, c], -4)
+
+    z2 = f2(zero, zero)
+    c0 = f6(f2(one, zero), z2, z2)
+    c1 = f6(z2, z2, z2)
+    return _stk([c0, c1], -5)
+
+
+def fp12_mul_t(a, b):
+    a0, a1 = _w(a, 0), _w(a, 1)
+    b0, b1 = _w(b, 0), _w(b, 1)
+    if _lowmem():
+        t0 = fp6_mul_t(a0, b0)
+        t1 = fp6_mul_t(a1, b1)
+        s = fp6_mul_t(add_t(a0, a1), add_t(b0, b1))
+    else:
+        t = fp6_mul_t(
+            _stk([a0, a1, add_t(a0, a1)], -5),
+            _stk([b0, b1, add_t(b0, b1)], -5),
+        )
+        t0, t1, s = (t[..., i, :, :, :, :] for i in range(3))
+    c0 = add_t(t0, fp6_mul_by_v_t(t1))
+    c1 = sub_t(sub_t(s, t0), t1)
+    return _stk([c0, c1], -5)
+
+
+def fp12_sqr_t(a):
+    a0, a1 = _w(a, 0), _w(a, 1)
+    if _lowmem():
+        t0 = fp6_mul_t(a0, a1)
+        s = fp6_mul_t(add_t(a0, a1), add_t(a0, fp6_mul_by_v_t(a1)))
+    else:
+        t = fp6_mul_t(
+            _stk([a0, add_t(a0, a1)], -5),
+            _stk([a1, add_t(a0, fp6_mul_by_v_t(a1))], -5),
+        )
+        t0, s = t[..., 0, :, :, :, :], t[..., 1, :, :, :, :]
+    c0 = sub_t(sub_t(s, t0), fp6_mul_by_v_t(t0))
+    c1 = double_t(t0)
+    return _stk([c0, c1], -5)
+
+
+def fp12_conj_t(a):
+    return _stk([_w(a, 0), fp6_neg_t(_w(a, 1))], -5)
+
+
+def fp12_inv_t(a):
+    a0, a1 = _w(a, 0), _w(a, 1)
+    if _lowmem():
+        s0, s1 = fp6_mul_t(a0, a0), fp6_mul_t(a1, a1)
+    else:
+        s = fp6_mul_t(_stk([a0, a1], -5), _stk([a0, a1], -5))
+        s0, s1 = s[..., 0, :, :, :, :], s[..., 1, :, :, :, :]
+    denom = sub_t(s0, fp6_mul_by_v_t(s1))
+    d_inv = fp6_inv_t(denom)
+    if _lowmem():
+        o0, o1 = fp6_mul_t(a0, d_inv), fp6_mul_t(a1, d_inv)
+    else:
+        o = fp6_mul_t(_stk([a0, a1], -5), _stk([d_inv, d_inv], -5))
+        o0, o1 = o[..., 0, :, :, :, :], o[..., 1, :, :, :, :]
+    return _stk([o0, fp6_neg_t(o1)], -5)
+
+
+def fp12_frobenius_t(a):
+    c0 = fp6_frobenius_t(_w(a, 0))
+    c1 = fp6_mul_fp2_t(fp6_frobenius_t(_w(a, 1)), _c2("FROB12_C1"))
+    return _stk([c0, c1], -5)
+
+
+def fp12_frobenius2_t(a):
+    return fp12_frobenius_t(fp12_frobenius_t(a))
+
+
+def fp12_eq_t(a, b):
+    return jnp.all(
+        canonical_t(a) == canonical_t(b), axis=(-5, -4, -3, -2)
+    )
+
+
+def fp12_is_one_t(a):
+    return fp12_eq_t(a, fp12_one_t(a[..., 0, 0, 0, :, :]))
+
+
+# ---------------------------------------------------------------- FieldOps
+
+
+class TFieldOps(FieldOps):
+    """FieldOps adapter for the transposed layout: lane masks broadcast
+    from the right (batch IS the trailing axis), so select needs no axis
+    padding; `zero`/`one` are [.., 48, 1] columns broadcasting over T."""
+
+    def select(self, mask, a, b):
+        return jnp.where(mask, a, b)
+
+
+def fp_ops_t() -> TFieldOps:
+    """FP FieldOps bound to the CURRENT constant bundle (call inside
+    bound_consts when tracing a kernel body)."""
+    return TFieldOps(
+        mul=mont_mul_t, sqr=mont_sqr_t, add=add_t, sub=sub_t,
+        neg=neg_t, double=double_t, inv=mont_inv_t,
+        is_zero=is_zero_t, eq=eq_t,
+        zero=jnp.zeros((N_LIMBS, 1), jnp.int32), one=_c("R"), ndim_tail=2,
+    )
+
+
+def fp2_ops_t() -> TFieldOps:
+    zero2 = jnp.zeros((2, N_LIMBS, 1), jnp.int32)
+    one2 = jnp.concatenate(
+        [_c("R")[None], jnp.zeros((1, N_LIMBS, 1), jnp.int32)]
+    )
+    return TFieldOps(
+        mul=fp2_mul_t, sqr=fp2_sqr_t, add=fp2_add_t, sub=fp2_sub_t,
+        neg=fp2_neg_t, double=fp2_double_t, inv=fp2_inv_t,
+        is_zero=fp2_is_zero_t, eq=fp2_eq_t,
+        zero=zero2, one=one2, ndim_tail=3,
+    )

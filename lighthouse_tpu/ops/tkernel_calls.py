@@ -1,0 +1,394 @@
+"""Fused Pallas kernels for the verifier's long sequential chains.
+
+Each kernel runs one long chain (RLC scalar-mul, subgroup check, affine
+normalization via Fermat inversion, Miller loop, final exponentiation)
+as a SINGLE Pallas program per batch tile: loop iterations inside a
+kernel cost ~μs, versus ~0.1-1ms per XLA-level op on this stack (the
+profiling that motivated this lives in ops/tkernel.py's docstring).
+
+Conventions shared by every kernel here:
+
+* transposed layout (ops/tkernel.py): limb axis on sublanes, batch on
+  lanes; tiles of TILE lanes; grid over batch tiles;
+* infinity masks travel as int32 [1, T] rows (Mosaic wants ≥2-D);
+* loop bit tables and the field-constant bundle are kernel inputs
+  (Pallas forbids captured array constants) — bit tables as [n, 1]
+  columns read with dynamic sublane indices, constants re-bound around
+  the traced body via tkernel.bound_consts;
+* ``interpret=True`` off-TPU so the CPU suite executes identical
+  semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..crypto.bls.constants import R as CURVE_ORDER
+from . import tkernel as tk
+from . import tkernel_pairing as tp
+from .points import pt_add, pt_add_mixed, pt_double, pt_from_affine
+from .tkernel import N_LIMBS
+
+ORDER_BITS_NP = tk.bits_msb_first(CURVE_ORDER)
+ORDER_NBITS = len(ORDER_BITS_NP)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _col(bits_np: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(bits_np.reshape(-1, 1))
+
+
+def _pad_lanes(a, t_pad: int):
+    if a.shape[-1] == t_pad:
+        return a
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, t_pad - a.shape[-1])]
+    return jnp.pad(a, pad)
+
+
+def _tile_for(t: int, cap: int) -> int:
+    return min(cap, max(128, -(-t // 128) * 128))
+
+
+def _specs(shapes, tile):
+    """BlockSpecs tiling the last axis; constant inputs pass tile=None."""
+    out = []
+    for nd, tiled in shapes:
+        if tiled:
+            block = (*nd, tile)
+            out.append(
+                pl.BlockSpec(block, lambda i, _n=len(block): (0,) * (_n - 1) + (i,))
+            )
+        else:
+            out.append(pl.BlockSpec(nd, lambda i, _n=len(nd): (0,) * _n))
+    return out
+
+
+# ------------------------------------------------------------- scalar mul
+
+
+def _scalar_mul_kernel(g2: bool):
+    def kernel(x_ref, y_ref, inf_ref, bits_ref, consts_ref, out_ref):
+        with tk.bound_consts(consts_ref[:]):
+            F = tk.fp2_ops_t() if g2 else tk.fp_ops_t()
+            x, y = x_ref[:], y_ref[:]
+            inf = inf_ref[0, :] != 0
+
+            zero = jnp.zeros_like(x)
+            one = jnp.broadcast_to(F.one, x.shape)
+            acc0 = (one, one, zero)                 # Jacobian infinity
+
+            def step(i, acc):
+                acc = pt_double(F, acc)
+                cand = pt_add_mixed(F, acc, (x, y), inf)
+                take = bits_ref[i, :] == 1
+                return tuple(
+                    jnp.where(take, c, a) for c, a in zip(cand, acc)
+                )
+
+            acc = jax.lax.fori_loop(0, bits_ref.shape[0], step, acc0)
+            out_ref[:] = jnp.stack(acc)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("g2", "interpret"))
+def _scalar_mul_t(x, y, inf, bits, *, g2: bool, interpret: bool):
+    """[k]Q per lane. x/y: [(2,)48,T]; inf: [1,T] int32; bits [nbits,T].
+    Returns Jacobian (X, Y, Z) stacked [3, (2,) 48, T]."""
+    t = x.shape[-1]
+    tile = _tile_for(t, 512 if not g2 else 256)
+    t_pad = -(-t // tile) * tile
+    x, y, inf, bits = (_pad_lanes(v, t_pad) for v in (x, y, inf, bits))
+    coord = (2, N_LIMBS) if g2 else (N_LIMBS,)
+    in_specs = _specs(
+        [(coord, True), (coord, True), ((1,), True),
+         ((bits.shape[0],), True), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out_spec = _specs([((3, *coord), True)], tile)[0]
+    out = pl.pallas_call(
+        _scalar_mul_kernel(g2),
+        out_shape=jax.ShapeDtypeStruct((3, *coord, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        interpret=interpret,
+    )(x, y, inf, bits, jnp.asarray(tk.CONSTS_NP))
+    return tuple(out[i, ..., :t] for i in range(3))
+
+
+def scalar_mul_g1_t(x, y, inf, bits):
+    return _scalar_mul_t(x, y, inf, bits, g2=False, interpret=_interpret())
+
+
+def scalar_mul_g2_t(x, y, inf, bits):
+    return _scalar_mul_t(x, y, inf, bits, g2=True, interpret=_interpret())
+
+
+# ---------------------------------------------------------- subgroup check
+
+
+def _subgroup_kernel(x_ref, y_ref, inf_ref, obits_ref, consts_ref, out_ref):
+    with tk.bound_consts(consts_ref[:]):
+        F = tk.fp2_ops_t()
+        x, y = x_ref[:], y_ref[:]
+        inf = inf_ref[0, :] != 0
+        P0 = pt_from_affine(F, x, y, inf)
+
+        def step(i, acc):
+            acc = pt_double(F, acc)
+            cand = pt_add(F, acc, P0)
+            return tuple(
+                jnp.where(obits_ref[i, 0] == 1, c, a)
+                for c, a in zip(cand, acc)
+            )
+
+        # leading order bit consumes P0 itself (pt_scalar_mul_const)
+        acc = jax.lax.fori_loop(1, ORDER_NBITS, step, P0)
+        out_ref[0, :] = F.is_zero(acc[2]).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _subgroup_check_g2(x, y, inf, interpret: bool):
+    t = x.shape[-1]
+    tile = _tile_for(t, 256)
+    t_pad = -(-t // tile) * tile
+    x, y, inf = (_pad_lanes(v, t_pad) for v in (x, y, inf))
+    in_specs = _specs(
+        [((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
+         ((ORDER_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out = pl.pallas_call(
+        _subgroup_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([((1,), True)], tile)[0],
+        interpret=interpret,
+    )(x, y, inf, _col(ORDER_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    return out[0, :t] != 0
+
+
+def subgroup_check_g2_t(x, y, inf):
+    """[r]Q == infinity per lane (points.pt_subgroup_check semantics:
+    infinity passes)."""
+    return _subgroup_check_g2(x, y, inf, _interpret())
+
+
+# ------------------------------------------------------------- to-affine
+
+
+def _to_affine_kernel(g2: bool):
+    def kernel(pt_ref, pinv_ref, consts_ref, out_ref, inf_ref):
+        with tk.bound_consts(consts_ref[:], pinv_bits=pinv_ref):
+            F = tk.fp2_ops_t() if g2 else tk.fp_ops_t()
+            X, Y, Z = pt_ref[0], pt_ref[1], pt_ref[2]
+            zi = F.inv(Z)
+            zi2 = F.sqr(zi)
+            out_ref[0] = F.mul(X, zi2)
+            out_ref[1] = F.mul(Y, F.mul(zi, zi2))
+            inf_ref[0, :] = F.is_zero(Z).astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("g2", "interpret"))
+def _to_affine_t(P, *, g2: bool, interpret: bool):
+    t = P[0].shape[-1]
+    tile = _tile_for(t, 256)
+    t_pad = -(-t // tile) * tile
+    stacked = _pad_lanes(jnp.stack(P), t_pad)
+    coord = (2, N_LIMBS) if g2 else (N_LIMBS,)
+    in_specs = _specs(
+        [((3, *coord), True), ((tk.PINV_NBITS, 1), False),
+         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out_specs = _specs([((2, *coord), True), ((1,), True)], tile)
+    out, inf = pl.pallas_call(
+        _to_affine_kernel(g2),
+        out_shape=(
+            jax.ShapeDtypeStruct((2, *coord, t_pad), jnp.int32),
+            jax.ShapeDtypeStruct((1, t_pad), jnp.int32),
+        ),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        interpret=interpret,
+    )(stacked, _col(tk.PINV_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    return out[0, ..., :t], out[1, ..., :t], inf[0, :t] != 0
+
+
+def to_affine_g1_t(P):
+    """Jacobian -> affine (x, y, inf-bool); infinity lanes zeroed
+    (points.pt_to_affine semantics)."""
+    return _to_affine_t(P, g2=False, interpret=_interpret())
+
+
+def to_affine_g2_t(P):
+    return _to_affine_t(P, g2=True, interpret=_interpret())
+
+
+# ----------------------------------------------------------- miller loop
+
+
+def _miller_kernel(xp_ref, yp_ref, pinf_ref, xq_ref, yq_ref, qinf_ref,
+                   mbits_ref, consts_ref, out_ref):
+    with tk.bound_consts(consts_ref[:], lowmem=True):
+        f = tp.miller_loop_t(
+            (xp_ref[:], yp_ref[:]),
+            pinf_ref[0, :] != 0,
+            (xq_ref[:], yq_ref[:]),
+            qinf_ref[0, :] != 0,
+            mbits_ref,
+        )
+        out_ref[:] = f
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
+    t = xp.shape[-1]
+    tile = _tile_for(t, 128)
+    t_pad = -(-t // tile) * tile
+    xp, yp, pinf, xq, yq, qinf = (
+        _pad_lanes(v, t_pad) for v in (xp, yp, pinf, xq, yq, qinf)
+    )
+    # padding lanes: force q_inf so they produce Fp12 one
+    if t_pad != t:
+        lane = jnp.arange(t_pad) >= t
+        qinf = jnp.maximum(qinf, lane[None, :].astype(jnp.int32))
+    in_specs = _specs(
+        [((N_LIMBS,), True), ((N_LIMBS,), True), ((1,), True),
+         ((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
+         ((tp.MILLER_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out = pl.pallas_call(
+        _miller_kernel,
+        out_shape=jax.ShapeDtypeStruct((2, 3, 2, N_LIMBS, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([((2, 3, 2, N_LIMBS), True)], tile)[0],
+        interpret=interpret,
+    )(xp, yp, pinf, xq, yq, qinf, _col(tp.MILLER_BITS_NP),
+      jnp.asarray(tk.CONSTS_NP))
+    return out[..., :t]
+
+
+def miller_loop_kernel_t(p_aff, p_inf, q_aff, q_inf):
+    """Batched Miller loop as one kernel; masks are bool [T]."""
+    return _miller_t(
+        p_aff[0], p_aff[1], p_inf[None, :].astype(jnp.int32),
+        q_aff[0], q_aff[1], q_inf[None, :].astype(jnp.int32),
+        _interpret(),
+    )
+
+
+# ------------------------------------------------------- final exponentiation
+
+
+# The full HHT chain holds four Fp12 values live (~3 MB each at a
+# 128-lane tile) plus product temporaries — over the 16 MB VMEM budget
+# as one program. It is therefore split into a pipeline of small
+# kernels (easy part / x-power / combine variants), each with ≤3 live
+# Fp12 values, all in lowmem mode (fp2-level stacking only).
+
+_F12_SHAPE = (2, 3, 2, N_LIMBS)
+
+
+def _easy_exp_kernel(f_ref, pinv_ref, consts_ref, out_ref):
+    """f^(p^6-1) then ^(p^2+1) (pairing.py final_exponentiation easy)."""
+    with tk.bound_consts(consts_ref[:], pinv_bits=pinv_ref, lowmem=True):
+        f = f_ref[:]
+        g = tk.fp12_mul_t(tk.fp12_conj_t(f), tk.fp12_inv_t(f))
+        out_ref[:] = tk.fp12_mul_t(tk.fp12_frobenius2_t(g), g)
+
+
+def _pow_kernel(xm1: bool):
+    def kernel(f_ref, xbits_ref, consts_ref, out_ref):
+        with tk.bound_consts(consts_ref[:], lowmem=True):
+            f = f_ref[:]
+            p = tp._cyc_pow_x_t(f, xbits_ref)
+            if xm1:  # f^(x-1) = f^x * conj(f)
+                p = tk.fp12_mul_t(p, tk.fp12_conj_t(f))
+            out_ref[:] = p
+
+    return kernel
+
+
+def _comb_kernel(mode: str):
+    def kernel(u_ref, v_ref, consts_ref, out_ref):
+        with tk.bound_consts(consts_ref[:], lowmem=True):
+            u, v = u_ref[:], v_ref[:]
+            if mode == "b":        # u * frob(v)
+                out = tk.fp12_mul_t(u, tk.fp12_frobenius_t(v))
+            elif mode == "c":      # u * frob2(v) * conj(v)
+                out = tk.fp12_mul_t(
+                    tk.fp12_mul_t(u, tk.fp12_frobenius2_t(v)),
+                    tk.fp12_conj_t(v),
+                )
+            else:                  # "final": u * v^2 * v
+                out = tk.fp12_mul_t(
+                    tk.fp12_mul_t(u, tk.fp12_sqr_t(v)), v
+                )
+            out_ref[:] = out
+
+    return kernel
+
+
+def _f12_call(kernel, operands, extra_specs, extras, t, interpret):
+    tile = _tile_for(t, 128)
+    t_pad = -(-t // tile) * tile
+    operands = [_pad_lanes(o, t_pad) for o in operands]
+    in_specs = _specs(
+        [(_F12_SHAPE, True)] * len(operands) + extra_specs, tile
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((*_F12_SHAPE, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([(_F12_SHAPE, True)], tile)[0],
+        interpret=interpret,
+    )(*operands, *extras)
+    return out[..., :t]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _final_exp_t(f, interpret: bool):
+    t = f.shape[-1]
+    consts = jnp.asarray(tk.CONSTS_NP)
+    cs = [((tk.N_CONSTS, N_LIMBS, 1), False)]
+    xb = [((tp.XPOW_NBITS, 1), False)] + cs
+    xbits = _col(tp.XPOW_BITS_NP)
+
+    def pow_(g, xm1):
+        return _f12_call(_pow_kernel(xm1), [g], xb, [xbits, consts],
+                         t, interpret)
+
+    def comb(u, v, mode):
+        return _f12_call(_comb_kernel(mode), [u, v], cs, [consts],
+                         t, interpret)
+
+    g = _f12_call(
+        _easy_exp_kernel, [f],
+        [((tk.PINV_NBITS, 1), False)] + cs,
+        [_col(tk.PINV_BITS_NP), consts], t, interpret,
+    )
+    a = pow_(pow_(g, True), True)
+    b = comb(pow_(a, False), a, "b")
+    c = comb(pow_(pow_(b, False), False), b, "c")
+    return comb(c, g, "final")
+
+
+def final_exp_kernel_t(f):
+    return _final_exp_t(f, _interpret())

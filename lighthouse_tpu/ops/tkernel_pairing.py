@@ -1,0 +1,140 @@
+"""Optimal-ate pairing on the transposed layout (ops/tkernel.py) — the
+arithmetic bodies of the fused Miller/final-exp Pallas kernels.
+
+Mirrors ops/pairing.py step-for-step (same Jacobian division-free line
+evaluation, same scaling factors annihilated by the final exponentiation,
+same HHT hard-part chain) with the limb axis on sublanes and batch on
+lanes. Loop bit tables are passed in by the caller (jnp arrays under XLA,
+SMEM refs inside Pallas kernels — see tkernel.pow_bits_t for why).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.constants import X
+from . import tkernel as tk
+from .points import pt_from_affine
+from .tkernel import (
+    add_t,
+    fp2_double_t,
+    fp2_mul_fp_t,
+    fp2_mul_t,
+    fp2_neg_t,
+    fp2_sqr_t,
+    fp2_sub_t,
+    fp2_triple_t,
+    fp12_conj_t,
+    fp12_mul_t,
+    fp12_one_t,
+    fp12_sqr_t,
+)
+
+_X_ABS = -X
+# Miller bits: below the leading bit, MSB first (pairing.py _X_BITS).
+MILLER_BITS_NP = np.asarray([int(b) for b in bin(_X_ABS)[3:]], np.int32)
+MILLER_NBITS = len(MILLER_BITS_NP)
+# x-power bits: full, MSB first (leading bit consumes the base).
+XPOW_BITS_NP = tk.bits_msb_first(_X_ABS)
+XPOW_NBITS = len(XPOW_BITS_NP)
+
+
+def _stk(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def _embed_line(A, B, C, xp, yp):
+    """Sparse line -> dense Fp12 (pairing.py _embed_line, transposed)."""
+    z = jnp.zeros(jnp.broadcast_shapes(A.shape, B.shape), jnp.int32)
+    c0 = _stk([A, fp2_mul_fp_t(B, xp), z], -4)
+    c1 = _stk([z, fp2_mul_fp_t(C, yp), z], -4)
+    return _stk([c0, c1], -5)
+
+
+def _dbl_step(T):
+    """Double T + line through T scaled by 2YZ^3 (pairing.py _dbl_step)."""
+    Xc, Yc, Zc = T
+    A_ = fp2_sqr_t(Xc)
+    B_ = fp2_sqr_t(Yc)
+    C_ = fp2_sqr_t(B_)
+    D_ = fp2_double_t(fp2_sub_t(fp2_sub_t(fp2_sqr_t(add_t(Xc, B_)), A_), C_))
+    E_ = fp2_triple_t(A_)
+    F_ = fp2_sqr_t(E_)
+    X3 = fp2_sub_t(F_, fp2_double_t(D_))
+    Y3 = fp2_sub_t(
+        fp2_mul_t(E_, fp2_sub_t(D_, X3)),
+        fp2_double_t(fp2_double_t(fp2_double_t(C_))),
+    )
+    Z3 = fp2_double_t(fp2_mul_t(Yc, Zc))
+    Z_sq = fp2_sqr_t(Zc)
+    lA = fp2_sub_t(fp2_mul_t(E_, Xc), fp2_double_t(B_))
+    lB = fp2_neg_t(fp2_mul_t(E_, Z_sq))
+    lC = fp2_mul_t(Z3, Z_sq)
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def _add_step(T, Qaff):
+    """T + Q (Q affine) + line scaled by 2ZH (pairing.py _add_step)."""
+    X1, Y1, Z1 = T
+    xq, yq = Qaff
+    Z1Z1 = fp2_sqr_t(Z1)
+    U2 = fp2_mul_t(xq, Z1Z1)
+    S2 = fp2_mul_t(yq, fp2_mul_t(Z1, Z1Z1))
+    H = fp2_sub_t(U2, X1)
+    r = fp2_double_t(fp2_sub_t(S2, Y1))
+    I = fp2_sqr_t(fp2_double_t(H))
+    J = fp2_mul_t(H, I)
+    V = fp2_mul_t(X1, I)
+    X3 = fp2_sub_t(fp2_sub_t(fp2_sqr_t(r), J), fp2_double_t(V))
+    Y3 = fp2_sub_t(fp2_mul_t(r, fp2_sub_t(V, X3)), fp2_double_t(fp2_mul_t(Y1, J)))
+    Z3 = fp2_sub_t(fp2_sub_t(fp2_sqr_t(add_t(Z1, H)), Z1Z1), fp2_sqr_t(H))
+    lA = fp2_sub_t(fp2_mul_t(r, xq), fp2_mul_t(Z3, yq))
+    lB = fp2_neg_t(r)
+    lC = Z3
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src):
+    """Batched Miller loop (pairing.py miller_loop, transposed).
+
+    p_aff: (xp, yp) [.., 48, T]; q_aff: (xq, yq) [.., 2, 48, T];
+    inf masks [T]; bit_src: MILLER_NBITS int32 bits, indexable."""
+    xp, yp = p_aff
+    F2 = tk.fp2_ops_t()
+    T0 = pt_from_affine(F2, q_aff[0], q_aff[1], q_inf)
+    f0 = fp12_one_t(xp)
+
+    def step(i, carry):
+        f, T = carry
+        f = fp12_sqr_t(f)
+        T2, line = _dbl_step(T)
+        f = fp12_mul_t(f, _embed_line(*line, xp, yp))
+        Ta, line_a = _add_step(T2, q_aff)
+        fa = fp12_mul_t(f, _embed_line(*line_a, xp, yp))
+        take = bit_src[i, 0] == 1
+        f = jnp.where(take, fa, f)
+        T = tuple(jnp.where(take, a, b) for a, b in zip(Ta, T2))
+        return (f, T)
+
+    f, _ = jax.lax.fori_loop(0, MILLER_NBITS, step, (f0, T0))
+    f = fp12_conj_t(f)  # x < 0
+    trivial = p_inf | q_inf
+    return jnp.where(trivial, fp12_one_t(xp), f)
+
+
+def _cyc_pow_x_t(f, bit_src):
+    """f^x (x negative BLS parameter), cyclotomic (pairing._cyc_pow_x)."""
+
+    def step(i, acc):
+        acc = fp12_sqr_t(acc)
+        return jnp.where(bit_src[i, 0] == 1, fp12_mul_t(acc, f), acc)
+
+    acc = jax.lax.fori_loop(1, XPOW_NBITS, step, f)
+    return fp12_conj_t(acc)
+
+
+# The full HHT final-exponentiation chain lives as a split-kernel
+# pipeline in tkernel_calls._final_exp_t (one monolithic kernel blows
+# the VMEM budget); _cyc_pow_x_t above is its x-power building block.

@@ -9,6 +9,7 @@ state_transition_vectors).
   main.rs + checks.rs).
 """
 
+from .compare_fields import assert_equal, compare_fields
 from .rig import LocalBeaconNode, LocalValidatorClient
 from .simulator import Simulator, SimulatorChecks
 
@@ -17,4 +18,6 @@ __all__ = [
     "LocalValidatorClient",
     "Simulator",
     "SimulatorChecks",
+    "assert_equal",
+    "compare_fields",
 ]

@@ -1,0 +1,57 @@
+"""Structural diffing of SSZ containers for tests.
+
+Capability mirror of `common/compare_fields(_derive)`: when two states or
+blocks mismatch, a root-hash comparison says nothing about WHERE — this
+walks both containers and reports the differing field paths, which is how
+the reference's state-transition tests present failures.
+"""
+
+from __future__ import annotations
+
+
+def _is_container(v) -> bool:
+    return hasattr(v, "fields") and hasattr(type(v), "schema")
+
+
+def compare_fields(a, b, path: str = "", max_diffs: int = 50) -> list[str]:
+    """Return human-readable paths of every differing field (depth-first,
+    capped at ``max_diffs``)."""
+    diffs: list[str] = []
+    _walk(a, b, path or type(a).__name__, diffs, max_diffs)
+    return diffs
+
+
+def _walk(a, b, path, diffs, cap) -> None:
+    if len(diffs) >= cap:
+        return
+    if type(a) is not type(b):
+        diffs.append(f"{path}: type {type(a).__name__} != {type(b).__name__}")
+        return
+    if _is_container(a):
+        for name in a.fields:
+            _walk(getattr(a, name), getattr(b, name),
+                  f"{path}.{name}", diffs, cap)
+        return
+    if isinstance(a, (list, tuple)):
+        la, lb = list(a), list(b)
+        if len(la) != len(lb):
+            diffs.append(f"{path}: length {len(la)} != {len(lb)}")
+            return
+        for i, (x, y) in enumerate(zip(la, lb)):
+            _walk(x, y, f"{path}[{i}]", diffs, cap)
+        return
+    if a != b:
+        ra = a.hex() if isinstance(a, (bytes, bytearray)) else repr(a)
+        rb = b.hex() if isinstance(b, (bytes, bytearray)) else repr(b)
+        if len(str(ra)) > 18:
+            ra, rb = f"{str(ra)[:16]}…", f"{str(rb)[:16]}…"
+        diffs.append(f"{path}: {ra} != {rb}")
+
+
+def assert_equal(a, b) -> None:
+    """Assert containers equal, raising with the differing paths."""
+    diffs = compare_fields(a, b)
+    if diffs:
+        raise AssertionError(
+            "containers differ:\n  " + "\n  ".join(diffs)
+        )

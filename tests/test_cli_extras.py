@@ -79,3 +79,29 @@ class TestMallocUtils:
         if metrics:  # glibc path
             assert metrics["arena"] > 0
             assert set(metrics) >= {"arena", "hblks", "uordblks"}
+
+
+class TestCompareFields:
+    """Structural container diffing (reference: common/compare_fields)."""
+
+    def test_equal_and_diff_paths(self):
+        from lighthouse_tpu.chain.harness import BeaconChainHarness
+        from lighthouse_tpu.testing.compare_fields import (
+            assert_equal,
+            compare_fields,
+        )
+
+        h = BeaconChainHarness(validator_count=8)
+        s1 = h.chain.head().state
+        s2 = s1.copy()
+        assert compare_fields(s1, s2) == []
+        assert_equal(s1, s2)
+        s2.slot = 99
+        s2.validators[0].effective_balance = 1
+        diffs = compare_fields(s1, s2)
+        assert any(".slot" in d for d in diffs)
+        assert any("validators[0].effective_balance" in d for d in diffs)
+        import pytest as _pytest
+
+        with _pytest.raises(AssertionError, match="slot"):
+            assert_equal(s1, s2)
