@@ -59,8 +59,17 @@ _IDX = {
     "FROB6_C1": 4,     # Fp2: rows 4-5
     "FROB6_C2": 6,     # rows 6-7
     "FROB12_C1": 8,    # rows 8-9
+    "PSI_CX": 10,      # rows 10-11: psi endomorphism x-coefficient
+    "PSI_CY": 12,      # rows 12-13: psi endomorphism y-coefficient
 }
-N_CONSTS = 10
+N_CONSTS = 14
+
+# Untwist-Frobenius-twist endomorphism coefficients for E'(Fp2):
+# psi(x, y) = (conj(x)*PSI_CX, conj(y)*PSI_CY), with psi(Q) = [x_bls]Q on
+# G2 — the fast subgroup criterion (Bowe, "Faster subgroup checks for
+# BLS12-381"). Loaded from the curve oracle's derivation
+# (crypto/bls/curve.py psi/_PSI_CX/_PSI_CY) in _build_consts, like the
+# Frobenius constants; psi(G) == [x]G is pinned by tests.
 
 
 def _build_consts() -> np.ndarray:
@@ -76,6 +85,12 @@ def _build_consts() -> np.ndarray:
     put("R", _limb.int_to_limbs(_limb.R_MONT))
     for name in ("FROB6_C1", "FROB6_C2", "FROB12_C1"):
         pair = np.asarray(getattr(tower, name))  # [2, 48] lane-limb layout
+        c[_IDX[name], :, 0] = pair[0]
+        c[_IDX[name] + 1, :, 0] = pair[1]
+    from ..crypto.bls import curve as _curve
+
+    for name, fq2 in (("PSI_CX", _curve._PSI_CX), ("PSI_CY", _curve._PSI_CY)):
+        pair = tower.fq2_to_dev(fq2)  # Montgomery form
         c[_IDX[name], :, 0] = pair[0]
         c[_IDX[name] + 1, :, 0] = pair[1]
     return c

@@ -184,6 +184,73 @@ def subgroup_check_g2_t(x, y, inf):
     return _subgroup_check_g2(x, y, inf, _interpret())
 
 
+def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, xbits_ref, consts_ref,
+                          out_ref):
+    """psi(Q) == [x_bls]Q (Bowe's criterion): ~64-step x-scalar chain +
+    one endomorphism evaluation, vs the 255-step full-order multiply of
+    _subgroup_kernel. Q is on-curve by deserialization; infinity passes
+    (pt_subgroup_check semantics)."""
+    with tk.bound_consts(consts_ref[:]):
+        F = tk.fp2_ops_t()
+        x, y = x_ref[:], y_ref[:]
+        inf = inf_ref[0, :] != 0
+
+        # [|x_bls|]Q, mixed double-and-add over the 64-bit parameter
+        def step(i, acc):
+            acc = pt_double(F, acc)
+            cand = pt_add_mixed(F, acc, (x, y), inf)
+            return tuple(
+                jnp.where(xbits_ref[i, 0] == 1, c, a)
+                for c, a in zip(cand, acc)
+            )
+
+        P0 = pt_from_affine(F, x, y, inf)
+        acc = jax.lax.fori_loop(1, tp.XPOW_NBITS, step, P0)
+        # x_bls < 0: [x]Q = -[|x|]Q
+        Xj, Yj, Zj = acc[0], F.neg(acc[1]), acc[2]
+
+        # psi(Q) = (conj(x)*CX, conj(y)*CY), affine
+        px = tk.fp2_mul_t(tk.fp2_conj_t(x), tk._c2("PSI_CX"))
+        py = tk.fp2_mul_t(tk.fp2_conj_t(y), tk._c2("PSI_CY"))
+
+        # affine-vs-Jacobian equality without inversion:
+        # px == Xj/Zj^2, py == Yj/Zj^3
+        z2 = F.sqr(Zj)
+        z3 = F.mul(z2, Zj)
+        eq = tk.fp2_eq_t(F.mul(px, z2), Xj) & tk.fp2_eq_t(F.mul(py, z3), Yj)
+        # [x]Q infinite while Q isn't -> not in G2 (psi(Q) finite)
+        eq = eq & ~F.is_zero(Zj)
+        out_ref[0, :] = (eq | inf).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
+    t = x.shape[-1]
+    tile = _tile_for(t, 256)
+    t_pad = -(-t // tile) * tile
+    x, y, inf = (_pad_lanes(v, t_pad) for v in (x, y, inf))
+    in_specs = _specs(
+        [((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
+         ((tp.XPOW_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out = pl.pallas_call(
+        _subgroup_fast_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([((1,), True)], tile)[0],
+        interpret=interpret,
+    )(x, y, inf, _col(tp.XPOW_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    return out[0, :t] != 0
+
+
+def subgroup_check_g2_fast_t(x, y, inf):
+    """Fast psi-criterion G2 membership; equivalent to
+    subgroup_check_g2_t (property-tested) at ~4x the speed."""
+    return _subgroup_check_g2_fast(x, y, inf, _interpret())
+
+
 # ------------------------------------------------------------- to-affine
 
 

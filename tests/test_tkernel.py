@@ -180,3 +180,44 @@ class TestFusedVerify:
             args[4], args[5], args[6],
         )
         assert not bool(jb._verify_fused_jit(*bad_args))
+
+
+class TestFastSubgroup:
+    def test_psi_constants_rederive(self):
+        """Pin the bundled PSI constants to the oracle: psi(G) == [x]G on
+        the generator (Bowe's criterion anchor)."""
+        from lighthouse_tpu.crypto.bls import curve as _curve
+        from lighthouse_tpu.crypto.bls.constants import P as _P, R as _R, X
+        from lighthouse_tpu.crypto.bls.curve import g2_generator
+        from lighthouse_tpu.crypto.bls.fields import Fq2
+
+        G = g2_generator()
+        xG = G.mul(X % _R)
+        conj = lambda a: Fq2(a.c0, (-a.c1) % _P)
+        assert xG.x * conj(G.x).inv() == _curve._PSI_CX
+        assert xG.y * conj(G.y).inv() == _curve._PSI_CY
+        # and the device bundle carries exactly those values
+        want_cx = np.asarray(tower.fq2_to_dev(_curve._PSI_CX))
+        got_cx = tk.CONSTS_NP[tk._IDX["PSI_CX"]:tk._IDX["PSI_CX"] + 2, :, 0]
+        assert (want_cx == got_cx).all()
+
+    def test_fast_equals_full_order_check(self):
+        """psi-criterion kernel == full-order-multiply kernel on subgroup
+        points, non-subgroup on-curve points, and infinity."""
+        from lighthouse_tpu.crypto.bls.curve import g2_generator
+        from lighthouse_tpu.crypto.bls.fields import Fq2
+        from lighthouse_tpu.crypto.bls.hash_to_curve import map_to_curve_g2
+
+        G = g2_generator()
+        points = [G.mul(k) for k in (1, 7, 12345)]
+        points += [map_to_curve_g2(Fq2(s + 2, 3 * s + 1)) for s in range(3)]
+        x, y, inf = pts.g2_to_dev(points)
+        inf[1] = True  # an infinity lane: both checks pass it
+
+        xt, yt = tk.batch_to_t(x), tk.batch_to_t(y)
+        mask = jnp.asarray(inf)[None, :].astype(jnp.int32)
+        slow = tc.subgroup_check_g2_t(xt, yt, mask)
+        fast = tc.subgroup_check_g2_fast_t(xt, yt, mask)
+        assert _eq(slow, fast)
+        # sanity on the expected pattern: 3 subgroup + inf pass, 3 fail
+        assert list(np.asarray(fast)) == [True, True, True, False, False, False]
