@@ -766,6 +766,28 @@ class BeaconApi:
             ])
         return {}
 
+    def prepare_beacon_proposer(self, preparations) -> dict:
+        """POST validator/prepare_beacon_proposer: per-proposer fee
+        recipients for payload attributes (http_api
+        post_validator_prepare_beacon_proposer -> execution layer
+        proposer preparation). Malformed entries are a 400 — a bad
+        address stored here would surface as a failed proposal when the
+        engine rejects the payload attributes."""
+        validated = []
+        for p in preparations or []:
+            try:
+                index = int(p["validator_index"])
+                recipient = str(p["fee_recipient"])
+                raw = bytes.fromhex(recipient.removeprefix("0x"))
+            except (KeyError, TypeError, ValueError) as e:
+                raise ApiError(400, f"malformed preparation: {e}")
+            if index < 0 or len(raw) != 20:
+                raise ApiError(400, f"invalid preparation for index {index}")
+            validated.append((index, "0x" + raw.hex()))
+        for index, recipient in validated:
+            self.chain.proposer_preparations[index] = recipient
+        return {}
+
     def subscribe_sync_committee(self, subscriptions) -> dict:
         """POST validator/sync_committee_subscriptions → sync subnet
         service (sync_subnets.rs path)."""

@@ -149,6 +149,13 @@ class BeaconNodeClient:
             body=subscriptions_json,
         )
 
+    def post_prepare_beacon_proposer(self, preparations_json):
+        return self._post(
+            "/eth/v1/validator/prepare_beacon_proposer",
+            lambda: self.api.prepare_beacon_proposer(preparations_json),
+            body=preparations_json,
+        )
+
     def post_sync_committee_subscriptions(self, subscriptions_json):
         return self._post(
             "/eth/v1/validator/sync_committee_subscriptions",

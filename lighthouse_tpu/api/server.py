@@ -64,6 +64,7 @@ route("GET", r"/eth/v1/validator/aggregate_attestation", "aggregate_attestation"
 route("POST", r"/eth/v1/validator/aggregate_and_proofs", "publish_aggregate_and_proofs")
 route("POST", r"/eth/v1/validator/beacon_committee_subscriptions", "subscribe_beacon_committee")
 route("POST", r"/eth/v1/validator/sync_committee_subscriptions", "subscribe_sync_committee")
+route("POST", r"/eth/v1/validator/prepare_beacon_proposer", "prepare_beacon_proposer")
 route("GET", r"/lighthouse/syncing", "lighthouse_syncing_state")
 route("GET", r"/lighthouse/proto_array", "lighthouse_proto_array")
 route("GET", r"/lighthouse/database", "lighthouse_database_info")
@@ -81,6 +82,7 @@ BODY_AS_PAYLOAD = {
     "publish_contribution_and_proofs",
     "subscribe_beacon_committee",
     "subscribe_sync_committee",
+    "prepare_beacon_proposer",
     "pool_proposer_slashings",
     "pool_attester_slashings",
 }

@@ -92,6 +92,9 @@ class BeaconChain:
         self.types = spec_types(spec.preset)
         # optional ExecutionLayer handle (reference: beacon_chain.execution_layer)
         self.execution_layer = None
+        # validator_index -> fee-recipient hex, from the VC's
+        # PreparationService (execution_layer proposer_preparation_data)
+        self.proposer_preparations: dict[int, str] = {}
         from .validator_monitor import ValidatorMonitor
 
         self.validator_monitor = ValidatorMonitor()
@@ -408,6 +411,10 @@ class BeaconChain:
 
         parent_hash = bytes(state.latest_execution_payload_header.block_hash)
         epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        proposer = h2.get_beacon_proposer_index(state, self.spec)
+        fee_recipient = self.proposer_preparations.get(
+            proposer, "0x" + "00" * 20
+        )
         attributes = {
             "timestamp": hex(
                 int(state.genesis_time) + slot * self.spec.SECONDS_PER_SLOT
@@ -415,7 +422,7 @@ class BeaconChain:
             "prevRandao": "0x" + bytes(
                 h2.get_randao_mix(state, epoch, self.spec)
             ).hex(),
-            "suggestedFeeRecipient": "0x" + "00" * 20,
+            "suggestedFeeRecipient": fee_recipient,
         }
         _, finalized_root = self._finalized_checkpoint
         finalized_hash = b"\x00" * 32

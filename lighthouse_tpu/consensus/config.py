@@ -188,6 +188,9 @@ class ChainSpec:
     DOMAIN_SYNC_COMMITTEE: bytes = (7).to_bytes(4, "little")
     DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF: bytes = (8).to_bytes(4, "little")
     DOMAIN_CONTRIBUTION_AND_PROOF: bytes = (9).to_bytes(4, "little")
+    # builder spec: application-reserved domain, computed against
+    # GENESIS_FORK_VERSION with a zero genesis_validators_root
+    DOMAIN_APPLICATION_BUILDER: bytes = bytes([0, 0, 0, 1])
 
     # -- fork schedule -------------------------------------------------------
     def fork_name_at_epoch(self, epoch: int) -> str:

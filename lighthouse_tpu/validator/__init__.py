@@ -23,6 +23,7 @@ from .doppelganger import DoppelgangerService
 from .duties import DutiesService
 from .fallback import BeaconNodeFallback
 from .keystore import Keystore, derive_master_sk, derive_validator_keys
+from .preparation import PreparationService, ValidatorRegistration
 from .services import AttestationService, BlockService, ValidatorClient
 from .slashing_protection import SlashingDatabase, SlashingError
 from .store import ValidatorStore
@@ -35,7 +36,9 @@ __all__ = [
     "DoppelgangerService",
     "DutiesService",
     "Keystore",
+    "PreparationService",
     "SlashingDatabase",
+    "ValidatorRegistration",
     "SlashingError",
     "ValidatorClient",
     "ValidatorStore",

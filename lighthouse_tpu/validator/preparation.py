@@ -1,0 +1,113 @@
+"""PreparationService — proposer preparations + builder registrations.
+
+Capability mirror of `validator_client/src/preparation_service.rs`: once
+per epoch the VC tells its BN which fee recipient each of its validators
+wants (``POST /eth/v1/validator/prepare_beacon_proposer`` — the BN feeds
+this into engine payload attributes), and, when an external builder is
+configured, signs and submits ``ValidatorRegistration`` messages
+(builder spec: signed under DOMAIN_APPLICATION_BUILDER computed against
+GENESIS_FORK_VERSION with a zero genesis_validators_root).
+"""
+
+from __future__ import annotations
+
+from ..consensus.config import ChainSpec
+from ..consensus.ssz import Bytes20, Bytes48, Container, uint64
+
+DEFAULT_GAS_LIMIT = 30_000_000
+
+
+class ValidatorRegistration(Container):
+    """builder spec ValidatorRegistrationV1."""
+
+    fields = {
+        "fee_recipient": Bytes20,
+        "gas_limit": uint64,
+        "timestamp": uint64,
+        "pubkey": Bytes48,
+    }
+
+
+class PreparationService:
+    def __init__(self, client, store, spec: ChainSpec,
+                 default_fee_recipient: str = "0x" + "00" * 20,
+                 gas_limit: int = DEFAULT_GAS_LIMIT):
+        self.client = client
+        self.store = store
+        self.spec = spec
+        self.default_fee_recipient = default_fee_recipient
+        self.gas_limit = gas_limit
+        # pubkey -> fee recipient hex (keymanager API feeds this)
+        self.fee_recipients: dict[bytes, str] = {}
+
+    def _recipient(self, pubkey: bytes) -> str:
+        return self.fee_recipients.get(pubkey, self.default_fee_recipient)
+
+    # ----------------------------------------------------------- BN prep
+    def prepare_proposers(self) -> int:
+        """POST proposer preparations for every validator with a known
+        index; returns how many were sent."""
+        preparations = []
+        for pubkey in self.store.voting_pubkeys():
+            index = self.store.index_of(pubkey)
+            if index is None:
+                continue
+            preparations.append({
+                "validator_index": index,
+                "fee_recipient": self._recipient(pubkey),
+            })
+        if preparations:
+            self.client.post_prepare_beacon_proposer(preparations)
+        return len(preparations)
+
+    # ------------------------------------------------------ builder prep
+    def builder_domain(self) -> bytes:
+        """compute_domain(DOMAIN_APPLICATION_BUILDER, GENESIS_FORK_VERSION,
+        zero root) — deliberately fork- and chain-history-independent
+        (builder spec)."""
+        return self.spec.compute_domain(
+            self.spec.DOMAIN_APPLICATION_BUILDER,
+            self.spec.GENESIS_FORK_VERSION,
+            b"\x00" * 32,
+        )
+
+    def signed_registrations(self, timestamp: int) -> list[dict]:
+        """Build + sign ValidatorRegistration messages for all validators
+        (signing_method.rs VALIDATOR_REGISTRATION type)."""
+        from ..consensus.config import compute_signing_root
+
+        domain = self.builder_domain()
+        out = []
+        for pubkey in self.store.voting_pubkeys():
+            message = ValidatorRegistration(
+                fee_recipient=bytes.fromhex(
+                    self._recipient(pubkey).removeprefix("0x")
+                ),
+                gas_limit=self.gas_limit,
+                timestamp=timestamp,
+                pubkey=pubkey,
+            )
+            root = compute_signing_root(message, domain)
+            sig = self.store._raw_sign(
+                pubkey, root, message_type="VALIDATOR_REGISTRATION"
+            )
+            out.append({
+                "message": {
+                    "fee_recipient": "0x" + bytes(
+                        message.fee_recipient
+                    ).hex(),
+                    "gas_limit": str(self.gas_limit),
+                    "timestamp": str(timestamp),
+                    "pubkey": "0x" + pubkey.hex(),
+                },
+                "signature": "0x" + sig.hex(),
+            })
+        return out
+
+    def register_with_builder(self, builder_client, timestamp: int) -> int:
+        """Submit signed registrations to an external builder
+        (builder_client.post_builder_validators path)."""
+        regs = self.signed_registrations(timestamp)
+        if regs:
+            builder_client.register_validators(regs)
+        return len(regs)

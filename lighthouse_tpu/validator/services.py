@@ -193,6 +193,9 @@ class ValidatorClient:
         self.sync_committee_service = SyncCommitteeService(
             client, self.store, self.duties, spec
         )
+        from .preparation import PreparationService
+
+        self.preparation_service = PreparationService(client, self.store, spec)
         self._last_polled_epoch: int | None = None
 
     def add_validators(self, secret_keys) -> None:
@@ -206,6 +209,10 @@ class ValidatorClient:
         if self._last_polled_epoch != epoch:
             self.duties.poll(epoch)
             self.sync_committee_service.poll(epoch)
+            try:
+                self.preparation_service.prepare_proposers()
+            except (ApiError, OSError):
+                pass  # older BNs without the endpoint / transport blips
             self._last_polled_epoch = epoch
             if self.store.doppelganger is not None:
                 self.store.doppelganger.advance_epoch(epoch)

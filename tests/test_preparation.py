@@ -1,0 +1,163 @@
+"""PreparationService tests (reference model: preparation_service.rs):
+proposer preparations reach the BN and steer payload fee recipients;
+builder registrations are signed under the builder domain."""
+
+import pytest
+
+from lighthouse_tpu.api import BeaconApi, BeaconNodeClient
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.validator import PreparationService, ValidatorStore
+
+
+@pytest.fixture()
+def rig():
+    harness = BeaconChainHarness(validator_count=8)
+    client = BeaconNodeClient(api=BeaconApi(harness.chain))
+    store = ValidatorStore(harness.spec, harness.chain.genesis_validators_root)
+    for i, sk in enumerate(harness.keys[:4]):
+        store.add_validator(sk, validator_index=i)
+    return harness, client, store
+
+
+class TestPreparation:
+    def test_preparations_reach_chain(self, rig):
+        harness, client, store = rig
+        svc = PreparationService(client, store, harness.spec,
+                                 default_fee_recipient="0x" + "11" * 20)
+        svc.fee_recipients[store.voting_pubkeys()[0]] = "0x" + "22" * 20
+        assert svc.prepare_proposers() == 4
+        preps = harness.chain.proposer_preparations
+        assert preps[0] == "0x" + "22" * 20       # per-key override
+        assert preps[1] == "0x" + "11" * 20       # default
+
+    def test_builder_registration_signed(self, rig):
+        harness, client, store = rig
+        svc = PreparationService(client, store, harness.spec)
+        regs = svc.signed_registrations(timestamp=1_700_000_000)
+        assert len(regs) == 4
+        reg = regs[0]
+        assert reg["message"]["pubkey"].startswith("0x")
+        assert len(bytes.fromhex(reg["signature"][2:])) == 96
+
+        # signature verifies under the builder domain (fork-independent)
+        from lighthouse_tpu.consensus.config import compute_signing_root
+        from lighthouse_tpu.crypto.bls.api import PublicKey, Signature
+        from lighthouse_tpu.validator.preparation import ValidatorRegistration
+
+        msg = ValidatorRegistration(
+            fee_recipient=bytes.fromhex(
+                reg["message"]["fee_recipient"][2:]
+            ),
+            gas_limit=int(reg["message"]["gas_limit"]),
+            timestamp=int(reg["message"]["timestamp"]),
+            pubkey=bytes.fromhex(reg["message"]["pubkey"][2:]),
+        )
+        root = compute_signing_root(msg, svc.builder_domain())
+        pk = PublicKey.from_bytes(bytes.fromhex(reg["message"]["pubkey"][2:]))
+        sig = Signature.from_bytes(bytes.fromhex(reg["signature"][2:]))
+        assert sig.verify(pk, root)
+
+    def test_register_with_mock_builder(self, rig):
+        from lighthouse_tpu.execution import (
+            BuilderHttpClient,
+            ExecutionBlockGenerator,
+            MockBuilder,
+        )
+
+        harness, client, store = rig
+        builder = MockBuilder(ExecutionBlockGenerator()).start()
+        try:
+            svc = PreparationService(client, store, harness.spec)
+            n = svc.register_with_builder(
+                BuilderHttpClient(builder.url), timestamp=1_700_000_000
+            )
+            assert n == 4
+            assert len(builder.registrations) == 4
+        finally:
+            builder.stop()
+
+    def test_malformed_preparation_rejected(self, rig):
+        from lighthouse_tpu.api import ApiError
+
+        harness, client, store = rig
+        for bad in (
+            [{"validator_index": 0, "fee_recipient": "0xZZ"}],
+            [{"validator_index": 0, "fee_recipient": "0x" + "11" * 19}],
+            [{"fee_recipient": "0x" + "11" * 20}],
+        ):
+            with pytest.raises(ApiError) as e:
+                client.post_prepare_beacon_proposer(bad)
+            assert e.value.status == 400
+        assert harness.chain.proposer_preparations == {}
+
+    def test_fee_recipient_flows_into_engine_payload(self):
+        """chain.proposer_preparations steers suggestedFeeRecipient all
+        the way into the engine-built payload (post-merge harness over
+        the mock engine — the reference's payload-attributes path)."""
+        import dataclasses
+
+        from lighthouse_tpu.chain.beacon_chain import BeaconChain
+        from lighthouse_tpu.common.slot_clock import ManualSlotClock
+        from lighthouse_tpu.consensus.config import minimal_spec
+        from lighthouse_tpu.consensus.genesis import (
+            interop_genesis_state,
+            interop_keypairs,
+        )
+        from lighthouse_tpu.consensus.types import spec_types
+        from lighthouse_tpu.crypto.bls import backends as bls_backends
+        from lighthouse_tpu.execution import (
+            EngineApiClient,
+            ExecutionBlockGenerator,
+            ExecutionLayer,
+            JwtAuth,
+            MockExecutionServer,
+        )
+        from lighthouse_tpu.store.hot_cold import HotColdDB, StoreConfig
+        from lighthouse_tpu.store.kv import MemoryStore
+
+        spec = dataclasses.replace(
+            minimal_spec(), ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0,
+        )
+        t = spec_types(spec.preset)
+        gen = ExecutionBlockGenerator(terminal_total_difficulty=0)
+        server = MockExecutionServer(gen, jwt_secret=b"\x07" * 32).start()
+        try:
+            el_genesis = gen.blocks[gen.head_hash]
+            header = t.ExecutionPayloadHeader(
+                block_hash=el_genesis.block_hash,
+                block_number=el_genesis.number,
+                timestamp=el_genesis.timestamp,
+            )
+            keys = interop_keypairs(16)
+            prev = bls_backends._default
+            bls_backends.set_default_backend("fake")
+            try:
+                genesis_state = interop_genesis_state(
+                    keys, 1_600_000_000, spec, sign_deposits=False,
+                    execution_payload_header=header,
+                )
+            finally:
+                bls_backends._default = prev
+            clock = ManualSlotClock(1_600_000_000, spec.SECONDS_PER_SLOT)
+            chain = BeaconChain.from_genesis(
+                HotColdDB(MemoryStore(), spec,
+                          StoreConfig(slots_per_restore_point=8)),
+                genesis_state, spec, clock, backend="fake",
+            )
+            chain.execution_layer = ExecutionLayer(
+                [EngineApiClient(server.url, jwt=JwtAuth(b"\x07" * 32))]
+            )
+            sentinel = "0x" + "33" * 20
+            for i in range(16):  # whoever proposes, the sentinel applies
+                chain.proposer_preparations[i] = sentinel
+            clock.advance_slot()
+            state = chain.head().state.copy()
+            from lighthouse_tpu.consensus.transition.slot import process_slots
+
+            state = process_slots(state, chain.current_slot(), spec)
+            payload = chain._produce_execution_payload(
+                state, chain.current_slot()
+            )
+            assert bytes(payload.fee_recipient).hex() == "33" * 20
+        finally:
+            server.stop()
