@@ -308,6 +308,90 @@ def _gen_state_vectors(root: str) -> None:
     _write_ssz_snappy(os.path.join(d, "post.ssz_snappy"), applied.encode())
 
 
+def _gen_fork_and_genesis(root: str) -> None:
+    """fork/fork upgrade vectors + genesis initialization/validity
+    (reference runners: fork, genesis)."""
+    import dataclasses
+
+    from ..consensus.config import minimal_spec
+    from ..consensus.genesis import (
+        genesis_deposits,
+        initialize_beacon_state_from_eth1,
+        interop_keypairs,
+        is_valid_genesis_state,
+    )
+    from ..consensus.transition.upgrade import (
+        upgrade_to_altair,
+        upgrade_to_bellatrix,
+    )
+
+    spec = minimal_spec()
+    genesis_spec = dataclasses.replace(
+        spec, MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16
+    )
+    h = BeaconChainHarness(validator_count=16, backend="python")
+    pre = h.chain.head().state.copy()
+
+    # fork: phase0 -> altair, then altair -> bellatrix
+    altair_spec = dataclasses.replace(spec, ALTAIR_FORK_EPOCH=0)
+    post_a = upgrade_to_altair(pre.copy(), altair_spec)
+    d = _case(root, "minimal", "altair", "fork", "fork", "pyspec_tests",
+              "fork_base")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), pre.encode())
+    _write_yaml(os.path.join(d, "meta.yaml"), {"fork": "altair"})
+    _write_ssz_snappy(os.path.join(d, "post.ssz_snappy"), post_a.encode())
+
+    merge_spec = dataclasses.replace(
+        spec, ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0
+    )
+    post_b = upgrade_to_bellatrix(post_a.copy(), merge_spec)
+    d = _case(root, "minimal", "bellatrix", "fork", "fork", "pyspec_tests",
+              "fork_base")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), post_a.encode())
+    _write_yaml(os.path.join(d, "meta.yaml"), {"fork": "bellatrix"})
+    _write_ssz_snappy(os.path.join(d, "post.ssz_snappy"), post_b.encode())
+
+    # genesis/initialization: enough signed deposits for a valid genesis
+    keys = interop_keypairs(16)
+    deposits = genesis_deposits(
+        keys, genesis_spec.preset.MAX_EFFECTIVE_BALANCE, genesis_spec,
+        sign=True,
+    )
+    eth1_hash = b"\x42" * 32
+    eth1_time = 1_606_824_000  # past MIN_GENESIS_TIME so the state is valid
+    state = initialize_beacon_state_from_eth1(
+        eth1_hash, eth1_time, deposits, genesis_spec
+    )
+    d = _case(root, "minimal_smallgenesis", "phase0", "genesis", "initialization",
+              "pyspec_tests", "from_deposits")
+    _write_yaml(os.path.join(d, "eth1.yaml"), {
+        "eth1_block_hash": "0x" + eth1_hash.hex(),
+        "eth1_timestamp": eth1_time,
+    })
+    _write_yaml(os.path.join(d, "meta.yaml"),
+                {"deposits_count": len(deposits)})
+    for i, dep in enumerate(deposits):
+        _write_ssz_snappy(
+            os.path.join(d, f"deposits_{i}.ssz_snappy"), dep.encode()
+        )
+    _write_ssz_snappy(os.path.join(d, "state.ssz_snappy"), state.encode())
+
+    # genesis/validity: the state above is valid; an underfilled one isn't
+    d = _case(root, "minimal_smallgenesis", "phase0", "genesis", "validity",
+              "pyspec_tests", "valid")
+    _write_ssz_snappy(os.path.join(d, "genesis.ssz_snappy"), state.encode())
+    _write_yaml(os.path.join(d, "is_valid.yaml"), True)
+
+    few = initialize_beacon_state_from_eth1(
+        eth1_hash, eth1_time, deposits[:4], genesis_spec
+    )
+    assert not is_valid_genesis_state(few, genesis_spec)
+    d = _case(root, "minimal_smallgenesis", "phase0", "genesis", "validity",
+              "pyspec_tests", "too_few_validators")
+    _write_ssz_snappy(os.path.join(d, "genesis.ssz_snappy"), few.encode())
+    _write_yaml(os.path.join(d, "is_valid.yaml"), False)
+
+
 def generate_vectors(root: str) -> int:
     """Write the full tree; returns number of case directories."""
     from ..consensus.config import minimal_spec
@@ -315,6 +399,7 @@ def generate_vectors(root: str) -> int:
     _gen_bls(root)
     _gen_shuffling(root, minimal_spec())
     _gen_state_vectors(root)
+    _gen_fork_and_genesis(root)
     count = 0
     for dirpath, dirnames, filenames in os.walk(os.path.join(root, "tests")):
         if filenames and not dirnames:

@@ -55,6 +55,12 @@ def _spec_for(config: str, fork: str):
             minimal_spec(),
             preset=dataclasses.replace(MINIMAL, SHARD_COMMITTEE_PERIOD=0),
         )
+    elif config == "minimal_smallgenesis":
+        # locally-generated genesis vectors: 16 signed deposits are
+        # enough to form a *valid* genesis under this config
+        spec = dataclasses.replace(
+            minimal_spec(), MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16
+        )
     elif config in ("minimal", "general"):
         spec = minimal_spec()
     else:
@@ -432,6 +438,84 @@ class SszStatic(Handler):
         assert obj.hash_tree_root() == _hex(roots["root"])
 
 
+class Fork(Handler):
+    """fork/fork vectors: pre-state (previous fork) + meta {fork} ->
+    upgraded post-state (reference: ef_tests fork handler over
+    upgrade/{altair,merge}.rs)."""
+
+    runner = "fork"
+    handler = "fork"
+
+    _PREV = {"altair": "phase0", "bellatrix": "altair"}
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition.upgrade import (
+            upgrade_to_altair,
+            upgrade_to_bellatrix,
+        )
+
+        meta = _read_yaml(os.path.join(case_dir, "meta.yaml"))
+        target = meta["fork"]
+        spec = _spec_for(config, target)
+        t = spec_types(spec.preset)
+        pre_cls = t.STATE_BY_FORK[self._PREV[target]]
+        pre = pre_cls.decode(
+            _read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"))
+        )
+        post = (
+            upgrade_to_altair(pre, spec)
+            if target == "altair"
+            else upgrade_to_bellatrix(pre, spec)
+        )
+        want = _read_ssz_snappy(os.path.join(case_dir, "post.ssz_snappy"))
+        assert post.encode() == want, "fork upgrade state mismatch"
+
+
+class GenesisInitialization(Handler):
+    """genesis/initialization: eth1 data + deposits -> genesis state
+    (reference: ef_tests genesis handler over genesis.rs)."""
+
+    runner = "genesis"
+    handler = "initialization"
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.genesis import initialize_beacon_state_from_eth1
+        from ..consensus.types import Deposit
+
+        spec = _spec_for(config, fork)
+        eth1 = _read_yaml(os.path.join(case_dir, "eth1.yaml"))
+        meta = _read_yaml(os.path.join(case_dir, "meta.yaml"))
+        deposits = [
+            Deposit.decode(_read_ssz_snappy(
+                os.path.join(case_dir, f"deposits_{i}.ssz_snappy")
+            ))
+            for i in range(int(meta["deposits_count"]))
+        ]
+        state = initialize_beacon_state_from_eth1(
+            _hex(eth1["eth1_block_hash"]),
+            int(eth1["eth1_timestamp"]),
+            deposits,
+            spec,
+        )
+        want = _read_ssz_snappy(os.path.join(case_dir, "state.ssz_snappy"))
+        assert state.encode() == want, "genesis state mismatch"
+
+
+class GenesisValidity(Handler):
+    runner = "genesis"
+    handler = "validity"
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.genesis import is_valid_genesis_state
+
+        spec = _spec_for(config, fork)
+        state = _state_cls(config, fork).decode(
+            _read_ssz_snappy(os.path.join(case_dir, "genesis.ssz_snappy"))
+        )
+        want = bool(_read_yaml(os.path.join(case_dir, "is_valid.yaml")))
+        assert is_valid_genesis_state(state, spec) == want
+
+
 # -------------------------------------------------------------------- driver
 def default_handlers() -> list[Handler]:
     hs: list[Handler] = [
@@ -450,11 +534,12 @@ def default_handlers() -> list[Handler]:
         )
     ]
     hs += [SszStatic(n) for n in ("Attestation", "AttestationData", "Checkpoint")]
+    hs += [Fork(), GenesisInitialization(), GenesisValidity()]
     return hs
 
 
 def run_handler(root: str, handler: Handler,
-                configs=("general", "minimal", "minimal_exitable", "mainnet")) -> list[CaseResult]:
+                configs=("general", "minimal", "minimal_exitable", "minimal_smallgenesis", "mainnet")) -> list[CaseResult]:
     """Walk tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>."""
     results: list[CaseResult] = []
     tests_root = os.path.join(root, "tests")

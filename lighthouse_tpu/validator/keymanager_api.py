@@ -21,7 +21,18 @@ class KeymanagerApi:
     def __init__(self, vc, token: str | None = None):
         self.vc = vc
         self.token = token or secrets.token_hex(16)
-        self.fee_recipients: dict[bytes, str] = {}
+        self._local_fee_recipients: dict[bytes, str] = {}
+
+    @property
+    def fee_recipients(self) -> dict:
+        """The PreparationService's dict when the VC has one (so
+        keymanager-set recipients reach the BN's payload attributes),
+        resolved at access time — robust to wiring order."""
+        prep = getattr(self.vc, "preparation_service", None)
+        return (
+            prep.fee_recipients if prep is not None
+            else self._local_fee_recipients
+        )
 
     # ------------------------------------------------------------- keystores
     def list_keystores(self) -> dict:
