@@ -123,7 +123,9 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("db", help="database tooling")
     _add_common(db)
     db.add_argument("--datadir", required=True)
-    db.add_argument("action", choices=("inspect", "version"))
+    db.add_argument("action", choices=("inspect", "version", "migrate", "compact"))
+    db.add_argument("--target", type=int, default=None,
+                    help="migrate: target schema version (default: current)")
 
     bench = sub.add_parser("bench", help="BLS device benchmark")
     bench.add_argument("--quick", action="store_true")
@@ -437,17 +439,42 @@ def run_lcli(args) -> int:
 
 
 def run_db(args) -> int:
+    """database_manager equivalents: inspect / version / migrate /
+    compact (database_manager/src/lib.rs subcommands)."""
     from .store.kv import KVStore
+    from .store.schema_change import (
+        CURRENT_SCHEMA_VERSION,
+        migrate_schema,
+        read_schema_version,
+    )
 
     store = KVStore(args.datadir)
-    if args.action == "version":
-        print(json.dumps({"schema_version": 1}))
+    try:
+        if args.action == "version":
+            print(json.dumps({
+                "schema_version": read_schema_version(store),
+                "current": CURRENT_SCHEMA_VERSION,
+            }))
+            return 0
+        if args.action == "migrate":
+            target = (args.target if args.target is not None
+                      else CURRENT_SCHEMA_VERSION)
+            version = migrate_schema(store, target)
+            print(json.dumps({"schema_version": version}))
+            return 0
+        if args.action == "compact":
+            store.compact()
+            print(json.dumps({"compacted": True}))
+            return 0
+        counts: dict[str, int] = {}
+        for column in (b"blk", b"ste", b"sum", b"met"):
+            counts[column.decode()] = sum(
+                1 for _ in store.iter_keys(column)
+            )
+        print(json.dumps(counts))
         return 0
-    counts: dict[str, int] = {}
-    for column in (b"blk", b"ste", b"sum", b"meta"):
-        counts[column.decode()] = sum(1 for _ in store.iter_column(column))
-    print(json.dumps(counts))
-    return 0
+    finally:
+        store.close()
 
 
 def run_bench(args) -> int:

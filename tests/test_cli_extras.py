@@ -105,3 +105,23 @@ class TestCompareFields:
 
         with _pytest.raises(AssertionError, match="slot"):
             assert_equal(s1, s2)
+
+
+class TestDbTooling:
+    """database_manager subcommands over a real on-disk datadir."""
+
+    def test_version_inspect_migrate_compact(self, tmp_path, capsys):
+        db_path = str(tmp_path / "chain.db")
+        rc = main(["bn", "--spec", "minimal", "--interop-validators", "8",
+                   "--slots", "2", "--datadir", db_path,
+                   "--debug-level", "crit"])
+        assert rc == 0
+        capsys.readouterr()
+        for action, key in (("version", "schema_version"),
+                            ("inspect", "blk"),
+                            ("migrate", "schema_version"),
+                            ("compact", "compacted")):
+            rc = main(["db", "--datadir", db_path, action])
+            assert rc == 0
+            out = json.loads(capsys.readouterr().out)
+            assert key in out
