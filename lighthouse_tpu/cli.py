@@ -10,9 +10,10 @@ Subcommands:
 * ``account``   — wallet/validator tooling: keystore create/import/list
   (account_manager).
 * ``lcli``      — dev utilities: interop-genesis, skip-slots,
-  transition-blocks, parse-ssz (testing/lcli).
-* ``db``        — database inspect/version (database_manager).
+  transition-blocks, parse-ssz, insecure-validators (testing/lcli).
+* ``db``        — database inspect/version/migrate/compact (database_manager).
 * ``bench``     — the BLS device benchmark (bench.py's workload).
+* ``boot-node`` — standalone discovery-only bootnode (boot_node).
 
 Every subcommand melts flags into the component configs exactly as the
 reference's get_config does; `--spec minimal|mainnet` picks the preset.
