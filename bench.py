@@ -3,24 +3,26 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Workload = BASELINE.json config #4 shape (gossip attestation batch): S
-single-pubkey signature sets, one distinct message each, verified through
-the fused device program (aggregation + RLC scalar muls + subgroup checks +
-multi-Miller + final exp). Timing is steady-state device time: the program
-is compiled and warmed, inputs are on device, and we time R repetitions of
-the full verify call (block_until_ready), reporting sets/sec.
+single-pubkey signature sets, one distinct message each.
 
-Correctness is re-validated on the benchmark device before timing (a valid
-batch must verify True and a tampered lane must flip it to False) — this
-pins the one true TPU-specific hazard (bf16 matmul passes silently breaking
-integer exactness; see ops/limb.py precision notes).
+Three rates are measured (VERDICT r1 items 2-3 — the headline must be
+END-TO-END and the baseline MEASURED):
 
-vs_baseline: the reference's blst CPU path is unavailable in this image (no
-Rust toolchain, no Python blst binding — BASELINE.md requires the baseline
-to be *measured*, not cited), so the denominator is the fastest CPU
-implementation present: this repo's pure-Python big-int RLC verifier, timed
-on a subsample and scaled. The resulting ratio therefore overstates the
-advantage vs blst; BENCH notes record both raw numbers so the judge can
-re-derive against any future measured blst figure.
+  * e2e        — JaxBackend.verify_signature_sets from SignatureSet
+                 objects to bool: batched device hash-to-G2 (fused SSWU
+                 kernels), host assembly, transfer, fused verify. This is
+                 the headline `value`.
+  * device     — steady-state device time of the fused verify program
+                 alone (inputs pre-staged, hash points precomputed).
+  * native CPU — the C++ BLS12-381 implementation (native/bls12381.cpp:
+                 Montgomery 6x64, same RLC batch check, hash included),
+                 timed on a subsample and scaled. `vs_baseline` = e2e /
+                 native. The pure-Python oracle rate is also recorded.
+
+Correctness is re-validated on the benchmark device before timing (valid
+batch -> True, tampered lane -> False) — pinning the one true
+TPU-specific hazard (bf16 matmul passes silently breaking integer
+exactness; see ops/limb.py precision notes).
 """
 
 from __future__ import annotations
@@ -31,6 +33,102 @@ import sys
 import time
 
 import numpy as np
+
+
+def slot_mode() -> None:
+    """BASELINE config #5: a full slot at registry scale.
+
+    BENCH_VALIDATORS validators (default 100k; 1M fits HBM) live in the
+    blsrt HBM table; one slot's attestation load = BENCH_COMMITTEES
+    aggregate sets of BENCH_COMMITTEE_SIZE attesters each, verified
+    end-to-end through the INDEXED backend path (device gather from the
+    table, device hashing, fused verify). Prints one JSON line.
+
+    Scale trick for the fixture: sk_i = i+1, so pk_{i+1} = pk_i + G (one
+    host point-add per key instead of a full scalar mul), and a set's
+    aggregate signature is (sum sk_i mod r) * H(m) — one G2 mul per set.
+    """
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        PublicKey,
+        SignatureSet,
+    )
+    from lighthouse_tpu.crypto.bls.constants import R as CURVE_ORDER
+    from lighthouse_tpu.crypto.bls.curve import g1_generator, g2_generator
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.jax_backend import JaxBackend
+    from lighthouse_tpu.ops.points import _mont_batch
+
+    N = int(os.environ.get("BENCH_VALIDATORS", "100000"))
+    S = int(os.environ.get("BENCH_COMMITTEES", "64"))
+    K = int(os.environ.get("BENCH_COMMITTEE_SIZE", "512"))
+
+    # Registry: pk_i = (i+1) * G by running addition; straight into the
+    # uint8 HBM planes (bypassing per-object PublicKey wrappers).
+    t0 = time.perf_counter()
+    g1 = g1_generator()
+    xs = np.empty((N, 48), np.uint8)
+    ys = np.empty((N, 48), np.uint8)
+    acc = g1
+    xints, yints = [], []
+    for i in range(N):
+        xints.append(acc.x.n)
+        yints.append(acc.y.n)
+        acc = acc.add(g1)
+    xs[:] = _mont_batch(xints).astype(np.uint8)
+    ys[:] = _mont_batch(yints).astype(np.uint8)
+    table = blsrt.DevicePubkeyTable()
+    table._host_x, table._host_y = xs, ys
+    table._n = table._cap = N
+    table._dirty = True
+    blsrt.set_device_table(table)
+    build_s = time.perf_counter() - t0
+
+    # One slot's aggregate sets: committee j = indices [j*K, (j+1)*K).
+    sets = []
+    g2 = g2_generator()
+    for j in range(S):
+        lo = (j * K) % max(N - K, 1)
+        idxs = list(range(lo, lo + K))
+        msg = int(j).to_bytes(32, "big")
+        sk_sum = sum(i + 1 for i in idxs) % CURVE_ORDER
+        agg_sig = AggregateSignature(hash_to_g2(msg).mul(sk_sum))
+        pks = [PublicKey.__new__(PublicKey) for _ in idxs]  # points unused
+        s = SignatureSet(agg_sig, pks, msg, signing_key_indices=idxs)
+        sets.append(s)
+
+    backend = JaxBackend()
+    assert backend._table_gather_args(sets, len(sets), K) is not None, (
+        "indexed path not engaged"
+    )
+    ok = backend.verify_signature_sets(sets)  # compile + warm
+    t0 = time.perf_counter()
+    ok = backend.verify_signature_sets(sets) and ok
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "full_slot_attester_verifications_per_sec",
+        "value": round(S * K / dt, 1),
+        "unit": "attester-signatures/sec",
+        "vs_baseline": 0.0,
+        "detail": {
+            "validators": N, "sets": S, "committee_size": K,
+            "verified": bool(ok),
+            "slot_ms": round(dt * 1e3, 1),
+            "sets_per_sec": round(S / dt, 2),
+            "table_build_s": round(build_s, 1),
+            "table_hbm_mb": round(N * 96 / 1e6, 1),
+            "device": jax.devices()[0].platform,
+        },
+    }))
 
 
 def main() -> None:
@@ -50,18 +148,17 @@ def main() -> None:
         SignatureSet,
         verify_signature_sets_python,
     )
-    from lighthouse_tpu.crypto.bls.curve import g2_infinity
-    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
     from lighthouse_tpu.jax_backend import (
+        JaxBackend,
         _rand_bits_array,
         _verify_fused_jit,
         _verify_jit,
     )
 
     # The fused Pallas-kernel verifier (ops/tkernel*.py) is the
-    # production TPU path: ~3-5x the classic XLA program. Off-TPU it
-    # would run in interpreter mode (minutes per call), so the classic
-    # path stays the default there. BENCH_FUSED=0/1 overrides.
+    # production TPU path. Off-TPU it would run in interpreter mode
+    # (minutes per call), so the classic path stays the default there.
+    # BENCH_FUSED=0/1 overrides.
     fused_choice = os.environ.get("BENCH_FUSED")
     if fused_choice is None:
         fused_choice = "1" if jax.default_backend() == "tpu" else "0"
@@ -69,14 +166,12 @@ def main() -> None:
     from lighthouse_tpu.ops.points import g1_to_dev, g2_to_dev
 
     quick = "--quick" in sys.argv
-    # Default batch 2048. Fused-path v5e measurements: 0.53s at S=64
-    # (121 sets/s), 1.47s at S=512 (350 sets/s), 4.94s at S=2048
-    # (415 sets/s) — vs the classic XLA program's 2.3s / 5.6s / 16.0s.
-    # Throughput still grows with batch; 2048 bounds compile time and
-    # matches the gossip-batch accumulation size (BASELINE config #4).
+    # Default batch 2048: bounds compile time and matches the
+    # gossip-batch accumulation size (BASELINE config #4). Throughput
+    # still grows with batch.
     S = int(os.environ.get("BENCH_SETS", "4" if quick else "2048"))
     REPS = int(os.environ.get("BENCH_REPS", "1" if quick else "2"))
-    BASELINE_SETS = int(os.environ.get("BENCH_BASELINE_SETS", "2" if quick else "4"))
+    BASELINE_SETS = int(os.environ.get("BENCH_BASELINE_SETS", "2" if quick else "48"))
 
     # --- build a valid S-set batch (distinct keys, distinct messages) -------
     sks = [SecretKey.from_int(i + 101) for i in range(S)]
@@ -85,6 +180,11 @@ def main() -> None:
         SignatureSet.single_pubkey(sk.sign(m), sk.public_key(), m)
         for sk, m in zip(sks, msgs)
     ]
+
+    backend = JaxBackend()
+
+    # --- device-only operand staging ---------------------------------------
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
 
     px, py, pinf = g1_to_dev([s.signing_keys[0].point for s in sets])
     px, py, pinf = px.reshape(S, 1, 48), py.reshape(S, 1, 48), pinf.reshape(S, 1)
@@ -116,32 +216,66 @@ def main() -> None:
                           "error": "exactness gate failed"}))
         sys.exit(1)
 
-    # --- timed region -------------------------------------------------------
+    # --- timed: device-only -------------------------------------------------
     t0 = time.perf_counter()
     for _ in range(REPS):
         bool(_verify(*dev_args))
-    dt = (time.perf_counter() - t0) / REPS
-    dev_sets_per_sec = S / dt
+    dev_dt = (time.perf_counter() - t0) / REPS
+    dev_rate = S / dev_dt
 
-    # --- CPU baseline (pure-Python big-int RLC; see module docstring) -------
+    # --- timed: end-to-end through the backend ------------------------------
+    assert backend.verify_signature_sets(sets)  # compile/warm the htc path
     t0 = time.perf_counter()
-    assert verify_signature_sets_python(sets[:BASELINE_SETS])
-    base_dt = time.perf_counter() - t0
-    base_sets_per_sec = BASELINE_SETS / base_dt
+    for _ in range(REPS):
+        assert backend.verify_signature_sets(sets)
+    e2e_dt = (time.perf_counter() - t0) / REPS
+    e2e_rate = S / e2e_dt
 
+    # --- measured native CPU baseline (C++; BASELINE.md mandate) ------------
+    detail = {
+        "batch_sets": S,
+        "device": jax.devices()[0].platform,
+        "device_only_sets_per_sec": round(dev_rate, 3),
+        "device_only_ms_per_batch": round(dev_dt * 1e3, 2),
+        "e2e_ms_per_batch": round(e2e_dt * 1e3, 2),
+        "cpu_cores": os.cpu_count(),
+    }
+    native_rate = None
+    try:
+        from lighthouse_tpu.crypto.bls.native_backend import load_native_backend
+
+        nb = load_native_backend()
+        if nb is not None:
+            sub = sets[:BASELINE_SETS]
+            assert nb.verify_signature_sets(sub)  # warm
+            t0 = time.perf_counter()
+            assert nb.verify_signature_sets(sub)
+            native_dt = time.perf_counter() - t0
+            native_rate = len(sub) / native_dt
+            detail["native_cpu_sets_per_sec"] = round(native_rate, 3)
+    except Exception as e:  # toolchain missing: record, don't die
+        detail["native_cpu_error"] = str(e)[:200]
+
+    # --- pure-Python oracle rate (context only) ------------------------------
+    t0 = time.perf_counter()
+    assert verify_signature_sets_python(sets[: max(2, BASELINE_SETS // 8)])
+    py_dt = time.perf_counter() - t0
+    detail["cpu_python_sets_per_sec"] = round(
+        max(2, BASELINE_SETS // 8) / py_dt, 3
+    )
+
+    base = native_rate if native_rate else detail["cpu_python_sets_per_sec"]
     print(json.dumps({
         "metric": "bls_sets_verified_per_sec",
-        "value": round(dev_sets_per_sec, 3),
+        "value": round(e2e_rate, 3),
         "unit": "sets/sec",
-        "vs_baseline": round(dev_sets_per_sec / base_sets_per_sec, 3),
-        "detail": {
-            "batch_sets": S,
-            "device": jax.devices()[0].platform,
-            "device_ms_per_batch": round(dt * 1e3, 2),
-            "cpu_python_baseline_sets_per_sec": round(base_sets_per_sec, 3),
-        },
+        "vs_baseline": round(e2e_rate / base, 3),
+        "detail": detail,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODE") == "slot" or "--slot" in sys.argv:
+        slot_mode()
+    else:
+        main()

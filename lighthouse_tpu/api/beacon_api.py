@@ -754,16 +754,20 @@ class BeaconApi:
         if self.network is not None:
             from ..network.subnet_service import ValidatorSubscription
 
-            self.network.process_attester_subscriptions([
-                ValidatorSubscription(
-                    validator_index=int(s["validator_index"]),
-                    committee_index=int(s["committee_index"]),
-                    slot=int(s["slot"]),
-                    committee_count_at_slot=int(s["committees_at_slot"]),
-                    is_aggregator=bool(s.get("is_aggregator", False)),
-                )
-                for s in subscriptions
-            ])
+            try:
+                parsed = [
+                    ValidatorSubscription(
+                        validator_index=int(s["validator_index"]),
+                        committee_index=int(s["committee_index"]),
+                        slot=int(s["slot"]),
+                        committee_count_at_slot=int(s["committees_at_slot"]),
+                        is_aggregator=bool(s.get("is_aggregator", False)),
+                    )
+                    for s in subscriptions
+                ]
+            except (KeyError, TypeError, ValueError) as e:
+                raise ApiError(400, f"malformed subscription: {e}")
+            self.network.process_attester_subscriptions(parsed)
         return {}
 
     def prepare_beacon_proposer(self, preparations) -> dict:
@@ -794,16 +798,20 @@ class BeaconApi:
         if self.network is not None:
             from ..network.subnet_service import SyncCommitteeSubscription
 
-            self.network.process_sync_subscriptions([
-                SyncCommitteeSubscription(
-                    validator_index=int(s["validator_index"]),
-                    sync_committee_indices=tuple(
-                        int(i) for i in s["sync_committee_indices"]
-                    ),
-                    until_epoch=int(s["until_epoch"]),
-                )
-                for s in subscriptions
-            ])
+            try:
+                parsed = [
+                    SyncCommitteeSubscription(
+                        validator_index=int(s["validator_index"]),
+                        sync_committee_indices=tuple(
+                            int(i) for i in s["sync_committee_indices"]
+                        ),
+                        until_epoch=int(s["until_epoch"]),
+                    )
+                    for s in subscriptions
+                ]
+            except (KeyError, TypeError, ValueError) as e:
+                raise ApiError(400, f"malformed subscription: {e}")
+            self.network.process_sync_subscriptions(parsed)
         return {}
 
     def pool_proposer_slashings(self, slashing_json_or_obj) -> dict:

@@ -519,25 +519,42 @@ class BeaconChain:
         """Batch path with poisoning fallback — the TPU hot loop
         (reference: batch_verify_unaggregated_attestations, batch.rs:130-210)."""
         candidates = []
-        for att in attestations:
-            try:
-                indexed, _ = self._gossip_attestation_checks(att)
-                if sum(att.aggregation_bits) != 1:
-                    raise AttestationError("unaggregated attestation must set one bit")
-                vi = int(indexed.attesting_indices[0])
-                epoch = int(att.data.target.epoch)
-                if self.observed_attesters.is_known(epoch, vi):
-                    raise AttestationError("duplicate attestation (prior seen)")
-                sig_set = sigs.indexed_attestation_signature_set(
-                    self._head.state,
-                    self.pubkey_cache.as_getter(),
-                    att.signature,
-                    indexed,
-                    self.spec,
-                )
-                candidates.append((att, indexed, vi, epoch, sig_set, None))
-            except (AttestationError, ValueError) as e:
-                candidates.append((att, None, None, None, None, e))
+        # Timed read lock over batch assembly (reference: batch.rs:63-66
+        # VALIDATOR_PUBKEY_CACHE_LOCK_TIMEOUT): registry imports on the
+        # block-import path cannot silently stall gossip verification. A
+        # timeout fails the BATCH (each attestation gets a retryable
+        # error, mirroring the reference's BeaconChainError), never the
+        # caller's drive loop.
+        from ..common.timeout_lock import LockTimeout
+
+        try:
+            lock_ctx = self.pubkey_cache.lock.read()
+            lock_ctx.__enter__()
+        except LockTimeout:
+            err = AttestationError("pubkey cache lock timeout")
+            return [err for _ in attestations]
+        try:
+            for att in attestations:
+                try:
+                    indexed, _ = self._gossip_attestation_checks(att)
+                    if sum(att.aggregation_bits) != 1:
+                        raise AttestationError("unaggregated attestation must set one bit")
+                    vi = int(indexed.attesting_indices[0])
+                    epoch = int(att.data.target.epoch)
+                    if self.observed_attesters.is_known(epoch, vi):
+                        raise AttestationError("duplicate attestation (prior seen)")
+                    sig_set = sigs.indexed_attestation_signature_set(
+                        self._head.state,
+                        self.pubkey_cache.as_getter(),
+                        att.signature,
+                        indexed,
+                        self.spec,
+                    )
+                    candidates.append((att, indexed, vi, epoch, sig_set, None))
+                except (AttestationError, ValueError) as e:
+                    candidates.append((att, None, None, None, None, e))
+        finally:
+            lock_ctx.__exit__(None, None, None)
 
         sets = [c[4] for c in candidates if c[4] is not None]
         results = []

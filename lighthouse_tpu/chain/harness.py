@@ -83,12 +83,14 @@ class BeaconChainHarness:
         signed_cls = self.types.SIGNED_BLOCK_BY_FORK[fork]
         if not self.sign:
             return signed_cls(message=block, signature=INFINITY_SIG)
-        state = self.chain.head().state
         epoch = int(block.slot) // self.spec.preset.SLOTS_PER_EPOCH
-        domain = self.spec.get_domain(
+        # The domain must use the fork version SCHEDULED for the block's
+        # epoch, not the head state's Fork container — at a fork boundary
+        # the head is still pre-fork while the block verifies post-fork
+        # (the reference VC derives this from the spec's fork schedule).
+        domain = self.spec.compute_domain(
             self.spec.DOMAIN_BEACON_PROPOSER,
-            epoch,
-            state.fork,
+            self.spec.fork_version_at_epoch(epoch),
             self.chain.genesis_validators_root,
         )
         root = compute_signing_root(block, domain)
@@ -101,9 +103,11 @@ class BeaconChainHarness:
         from ..consensus.ssz import merkleize_chunks, uint64
 
         epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
-        state = self.chain.head().state
-        domain = self.spec.get_domain(
-            self.spec.DOMAIN_RANDAO, epoch, state.fork,
+        # Scheduled-fork domain (see sign_block): randao for a boundary
+        # block verifies under the new fork's version.
+        domain = self.spec.compute_domain(
+            self.spec.DOMAIN_RANDAO,
+            self.spec.fork_version_at_epoch(epoch),
             self.chain.genesis_validators_root,
         )
         root = merkleize_chunks([uint64.hash_tree_root(epoch), domain])
@@ -168,11 +172,11 @@ class BeaconChainHarness:
     def _attestation_signature(self, validator_index: int, data) -> bytes:
         if not self.sign:
             return INFINITY_SIG
-        state = self.chain.head().state
-        domain = self.spec.get_domain(
+        # Scheduled-fork domain (see sign_block): target-epoch version
+        # from the spec's fork schedule, not the head's Fork container.
+        domain = self.spec.compute_domain(
             self.spec.DOMAIN_BEACON_ATTESTER,
-            int(data.target.epoch),
-            state.fork,
+            self.spec.fork_version_at_epoch(int(data.target.epoch)),
             self.chain.genesis_validators_root,
         )
         root = compute_signing_root(data, domain)

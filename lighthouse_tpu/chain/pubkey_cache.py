@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import struct
 
+from ..common.timeout_lock import TimeoutRwLock
 from ..crypto.bls.api import PublicKey
 
 COL_PUBKEY = b"pkc"
@@ -23,6 +24,27 @@ class ValidatorPubkeyCache:
         self.pubkeys: list[PublicKey] = []
         self.indices: dict[bytes, int] = {}
         self.store = store
+        # Deadline-bounded RW lock (the reference's
+        # VALIDATOR_PUBKEY_CACHE_LOCK_TIMEOUT, batch.rs:63-66): signature
+        # batch assembly on processor/HTTP threads takes read, registry
+        # imports take write; contention past 1s raises instead of
+        # deadlocking.
+        self.lock = TimeoutRwLock()
+        # Optional HBM mirror (blsrt.DevicePubkeyTable): appended in sync
+        # so the device backend can gather by validator index.
+        self.device_table = None
+
+    def attach_device_table(self, table, register: bool = True) -> None:
+        """Mirror this cache into an HBM table (and optionally register it
+        as the process-wide table the JAX backend consults). Uploads the
+        current contents immediately."""
+        from .. import blsrt
+
+        self.device_table = table
+        if len(self.pubkeys) > len(table):
+            table.append_pubkeys(self.pubkeys[len(table):])
+        if register:
+            blsrt.set_device_table(table)
 
     @classmethod
     def from_state(cls, state, store=None) -> "ValidatorPubkeyCache":
@@ -58,9 +80,12 @@ class ValidatorPubkeyCache:
             new.append((compressed, pk))
         if self.store is not None and ops:
             self.store.batch(ops)
-        for compressed, pk in new:
-            self.indices[compressed] = len(self.pubkeys)
-            self.pubkeys.append(pk)
+        with self.lock.write():
+            for compressed, pk in new:
+                self.indices[compressed] = len(self.pubkeys)
+                self.pubkeys.append(pk)
+        if self.device_table is not None and new:
+            self.device_table.append_pubkeys([pk for _, pk in new])
 
     def get(self, index: int) -> PublicKey | None:
         if 0 <= index < len(self.pubkeys):

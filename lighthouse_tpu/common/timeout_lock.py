@@ -1,0 +1,102 @@
+"""TimeoutRwLock — deadline-bounded reader/writer lock.
+
+Capability mirror of the reference's
+`beacon_node/beacon_chain/src/timeout_rw_lock.rs`: lock acquisitions take
+a deadline, and hitting it raises (plus bumps a metric) instead of
+deadlocking — the codebase's one runtime race-detection mechanism. The
+reference guards the validator pubkey cache and snapshot caches with it
+(attestation_verification/batch.rs:63-66,
+VALIDATOR_PUBKEY_CACHE_LOCK_TIMEOUT = 1s); here the same timeout guards
+the pubkey cache against HTTP-server / processor-thread contention.
+
+Disable-switch parity: the reference's `--disable-lock-timeouts` flag
+(beacon_node/src/lib.rs:78-81) maps to ``TimeoutRwLock.enabled``.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from .metrics import REGISTRY
+
+LOCK_TIMEOUT_SECONDS = 1.0
+
+_TIMEOUTS = REGISTRY.counter(
+    "lock_timeouts_total", "TimeoutRwLock acquisitions that hit the deadline"
+)
+
+
+class LockTimeout(RuntimeError):
+    """A reader or writer waited past the deadline — the analog of the
+    reference's LockTimeout error (contention surfaced, not deadlocked)."""
+
+
+class TimeoutRwLock:
+    """Writer-preferring RW lock with deadline-bounded acquisition."""
+
+    enabled: bool = True  # process-wide switch (--disable-lock-timeouts)
+
+    def __init__(self, timeout: float = LOCK_TIMEOUT_SECONDS):
+        self.timeout = timeout
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    # ------------------------------------------------------------- readers
+    @contextmanager
+    def read(self, timeout: float | None = None):
+        self._acquire_read(timeout)
+        try:
+            yield
+        finally:
+            self._release_read()
+
+    def _acquire_read(self, timeout: float | None) -> None:
+        deadline = self.timeout if timeout is None else timeout
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: not self._writer and not self._writers_waiting,
+                timeout=deadline if self.enabled else None,
+            )
+            if not ok:
+                _TIMEOUTS.inc()
+                raise LockTimeout("read lock timeout")
+            self._readers += 1
+
+    def _release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------- writers
+    @contextmanager
+    def write(self, timeout: float | None = None):
+        self._acquire_write(timeout)
+        try:
+            yield
+        finally:
+            self._release_write()
+
+    def _acquire_write(self, timeout: float | None) -> None:
+        deadline = self.timeout if timeout is None else timeout
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                ok = self._cond.wait_for(
+                    lambda: not self._writer and self._readers == 0,
+                    timeout=deadline if self.enabled else None,
+                )
+                if not ok:
+                    _TIMEOUTS.inc()
+                    raise LockTimeout("write lock timeout")
+                self._writer = True
+            finally:
+                self._writers_waiting -= 1
+
+    def _release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()

@@ -131,7 +131,8 @@ def indexed_attestation_signature_set(
     )
     pubkeys = [_pk(get_pubkey, i) for i in indexed.attesting_indices]
     return SignatureSet.multiple_pubkeys(
-        _sig(signature), pubkeys, signing_root_of(indexed.data, domain)
+        _sig(signature), pubkeys, signing_root_of(indexed.data, domain),
+        indices=[int(i) for i in indexed.attesting_indices],
     )
 
 

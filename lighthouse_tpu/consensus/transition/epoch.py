@@ -199,83 +199,21 @@ def get_proposer_reward_phase0(state, index: int, total_balance: int, spec) -> i
 
 
 def process_rewards_and_penalties_phase0(state, spec) -> None:
+    """Sum of the five component deltas (rewards.py) — the same functions
+    the rewards ef_tests runner checks file-by-file, so the transition and
+    the vectors cannot drift apart."""
     if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
         return
-    n = len(state.validators)
-    rewards = [0] * n
-    penalties = [0] * n
-    caches: dict = {}
-    prev = h.get_previous_epoch(state, spec)
-    total_balance = h.get_total_active_balance(state, spec)
-    eligible = get_eligible_validator_indices(state, spec)
-    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
-    leak = is_in_inactivity_leak(state, spec)
+    from .rewards import attestation_deltas_phase0
 
-    source_atts = get_matching_source_attestations(state, prev, spec)
-    target_atts = get_matching_target_attestations(state, prev, spec)
-    head_atts = get_matching_head_attestations(state, prev, spec)
-
-    # Source / target / head component deltas.
-    for atts in (source_atts, target_atts, head_atts):
-        unslashed = get_unslashed_attesting_indices(state, atts, spec, caches)
-        attesting_balance = h.get_total_balance(state, unslashed, spec)
-        for index in eligible:
-            base = get_base_reward_phase0(state, index, total_balance, spec)
-            if index in unslashed:
-                if leak:
-                    rewards[index] += base
-                else:
-                    rewards[index] += (
-                        base
-                        * (attesting_balance // increment)
-                        // (total_balance // increment)
-                    )
-            else:
-                penalties[index] += base
-
-    # Proposer + inclusion-delay rewards.
-    source_unslashed = get_unslashed_attesting_indices(
-        state, source_atts, spec, caches
-    )
-    for index in source_unslashed:
-        candidates = [
-            a
-            for a in source_atts
-            if index
-            in h.get_attesting_indices(
-                state, a.data, a.aggregation_bits, spec,
-                _cache_for(state, a.data.target.epoch, spec, caches),
-            )
-        ]
-        attestation = min(candidates, key=lambda a: a.inclusion_delay)
-        base = get_base_reward_phase0(state, index, total_balance, spec)
-        proposer_reward = base // spec.preset.PROPOSER_REWARD_QUOTIENT
-        rewards[attestation.proposer_index] += proposer_reward
-        max_attester_reward = base - proposer_reward
-        rewards[index] += max_attester_reward // attestation.inclusion_delay
-
-    # Inactivity penalties.
-    if leak:
-        target_unslashed = get_unslashed_attesting_indices(
-            state, target_atts, spec, caches
+    components = attestation_deltas_phase0(state, spec)
+    for i in range(len(state.validators)):
+        h.increase_balance(
+            state, i, sum(r[i] for r, _ in components.values())
         )
-        delay = get_finality_delay(state, spec)
-        for index in eligible:
-            base = get_base_reward_phase0(state, index, total_balance, spec)
-            penalties[index] += (
-                BASE_REWARDS_PER_EPOCH * base
-                - get_proposer_reward_phase0(state, index, total_balance, spec)
-            )
-            if index not in target_unslashed:
-                penalties[index] += (
-                    state.validators[index].effective_balance
-                    * delay
-                    // spec.preset.INACTIVITY_PENALTY_QUOTIENT
-                )
-
-    for i in range(n):
-        h.increase_balance(state, i, rewards[i])
-        h.decrease_balance(state, i, penalties[i])
+        h.decrease_balance(
+            state, i, sum(p[i] for _, p in components.values())
+        )
 
 
 # ------------------------------------------------- altair: participation path
@@ -351,57 +289,20 @@ def _base_reward_altair(state, index, spec, per_increment) -> int:
 
 
 def process_rewards_and_penalties_altair(state, spec) -> None:
+    """Sum of per-flag + inactivity deltas (rewards.py; see the phase0
+    twin for why the runner and transition share these functions)."""
     if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
         return
-    n = len(state.validators)
-    rewards = [0] * n
-    penalties = [0] * n
-    prev = h.get_previous_epoch(state, spec)
-    total_balance = h.get_total_active_balance(state, spec)
-    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
-    active_increments = total_balance // increment
-    per_increment = get_base_reward_per_increment(state, spec)
-    eligible = get_eligible_validator_indices(state, spec)
-    leak = is_in_inactivity_leak(state, spec)
+    from .rewards import attestation_deltas_altair
 
-    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        unslashed = get_unslashed_participating_indices(
-            state, flag_index, prev, spec
+    components = attestation_deltas_altair(state, spec)
+    for i in range(len(state.validators)):
+        h.increase_balance(
+            state, i, sum(r[i] for r, _ in components.values())
         )
-        unslashed_balance = h.get_total_balance(state, unslashed, spec)
-        unslashed_increments = unslashed_balance // increment
-        for index in eligible:
-            base = _base_reward_altair(state, index, spec, per_increment)
-            if index in unslashed:
-                if not leak:
-                    numerator = base * weight * unslashed_increments
-                    rewards[index] += numerator // (
-                        active_increments * WEIGHT_DENOMINATOR
-                    )
-            elif flag_index != TIMELY_HEAD_FLAG_INDEX:
-                penalties[index] += base * weight // WEIGHT_DENOMINATOR
-
-    # Inactivity-score penalties.
-    if state_fork_name(state) == "bellatrix":
-        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
-    else:
-        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
-    target_participants = get_unslashed_participating_indices(
-        state, TIMELY_TARGET_FLAG_INDEX, prev, spec
-    )
-    for index in eligible:
-        if index not in target_participants:
-            penalty_numerator = (
-                state.validators[index].effective_balance
-                * state.inactivity_scores[index]
-            )
-            penalties[index] += penalty_numerator // (
-                spec.INACTIVITY_SCORE_BIAS * quotient
-            )
-
-    for i in range(n):
-        h.increase_balance(state, i, rewards[i])
-        h.decrease_balance(state, i, penalties[i])
+        h.decrease_balance(
+            state, i, sum(p[i] for _, p in components.values())
+        )
 
 
 # ------------------------------------------------------------ shared stages
@@ -507,7 +408,9 @@ def process_participation_record_updates(state) -> None:
     state.current_epoch_attestations = []
 
 
-def process_participation_flag_updates(state) -> None:
+def process_participation_flag_updates(state, spec=None) -> None:
+    """spec is unused (kept for the uniform sub-transition call shape the
+    ef_tests epoch_processing handler and process_epoch share)."""
     state.previous_epoch_participation = state.current_epoch_participation
     state.current_epoch_participation = [0] * len(state.validators)
 

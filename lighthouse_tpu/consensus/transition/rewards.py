@@ -1,0 +1,237 @@
+"""Per-component attestation reward/penalty deltas.
+
+The spec (and the reference's rewards ef_tests runner,
+testing/ef_tests/src/cases/rewards.rs) decomposes epoch rewards into
+named components, each a Deltas{rewards[], penalties[]} vector:
+phase0 — source/target/head, inclusion_delay, inactivity_penalty;
+altair — per participation flag + inactivity_penalty.
+
+``process_rewards_and_penalties_*`` in epoch.py is built ON these
+functions, so the vectors the rewards runner checks and the state
+transition itself cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from .. import helpers as h
+from ..config import (
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+from .epoch import (
+    BASE_REWARDS_PER_EPOCH,
+    _base_reward_altair,
+    _cache_for,
+    get_base_reward_phase0,
+    get_base_reward_per_increment,
+    get_eligible_validator_indices,
+    get_finality_delay,
+    get_matching_head_attestations,
+    get_matching_source_attestations,
+    get_matching_target_attestations,
+    get_proposer_reward_phase0,
+    get_unslashed_attesting_indices,
+    get_unslashed_participating_indices,
+    is_in_inactivity_leak,
+)
+
+
+def _zeros(state):
+    n = len(state.validators)
+    return [0] * n, [0] * n
+
+
+# ------------------------------------------------------------------ phase0
+
+
+def _component_deltas(state, attestations, spec, caches):
+    """Spec get_attestation_component_deltas: scaled rewards to unslashed
+    attesters (full base reward during a leak), base-reward penalties to
+    eligible non-attesters."""
+    rewards, penalties = _zeros(state)
+    total_balance = h.get_total_active_balance(state, spec)
+    unslashed = get_unslashed_attesting_indices(state, attestations, spec, caches)
+    attesting_balance = h.get_total_balance(state, unslashed, spec)
+    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    leak = is_in_inactivity_leak(state, spec)
+    for index in get_eligible_validator_indices(state, spec):
+        base = get_base_reward_phase0(state, index, total_balance, spec)
+        if index in unslashed:
+            if leak:
+                rewards[index] += base
+            else:
+                rewards[index] += (
+                    base
+                    * (attesting_balance // increment)
+                    // (total_balance // increment)
+                )
+        else:
+            penalties[index] += base
+    return rewards, penalties
+
+
+def get_source_deltas(state, spec, caches=None):
+    caches = {} if caches is None else caches
+    prev = h.get_previous_epoch(state, spec)
+    return _component_deltas(
+        state, get_matching_source_attestations(state, prev, spec), spec, caches
+    )
+
+
+def get_target_deltas(state, spec, caches=None):
+    caches = {} if caches is None else caches
+    prev = h.get_previous_epoch(state, spec)
+    return _component_deltas(
+        state, get_matching_target_attestations(state, prev, spec), spec, caches
+    )
+
+
+def get_head_deltas(state, spec, caches=None):
+    caches = {} if caches is None else caches
+    prev = h.get_previous_epoch(state, spec)
+    return _component_deltas(
+        state, get_matching_head_attestations(state, prev, spec), spec, caches
+    )
+
+
+def get_inclusion_delay_deltas(state, spec, caches=None):
+    """Proposer micro-reward + delay-scaled attester reward for the
+    earliest inclusion of each source attester; no penalties."""
+    caches = {} if caches is None else caches
+    rewards, penalties = _zeros(state)
+    total_balance = h.get_total_active_balance(state, spec)
+    prev = h.get_previous_epoch(state, spec)
+    source_atts = get_matching_source_attestations(state, prev, spec)
+    for index in get_unslashed_attesting_indices(state, source_atts, spec, caches):
+        candidates = [
+            a
+            for a in source_atts
+            if index
+            in h.get_attesting_indices(
+                state, a.data, a.aggregation_bits, spec,
+                _cache_for(state, a.data.target.epoch, spec, caches),
+            )
+        ]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        base = get_base_reward_phase0(state, index, total_balance, spec)
+        proposer_reward = base // spec.preset.PROPOSER_REWARD_QUOTIENT
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = base - proposer_reward
+        rewards[index] += max_attester_reward // attestation.inclusion_delay
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas_phase0(state, spec, caches=None):
+    """Quadratic-leak penalties; zero outside a leak."""
+    caches = {} if caches is None else caches
+    rewards, penalties = _zeros(state)
+    if not is_in_inactivity_leak(state, spec):
+        return rewards, penalties
+    total_balance = h.get_total_active_balance(state, spec)
+    prev = h.get_previous_epoch(state, spec)
+    target_unslashed = get_unslashed_attesting_indices(
+        state, get_matching_target_attestations(state, prev, spec), spec, caches
+    )
+    delay = get_finality_delay(state, spec)
+    for index in get_eligible_validator_indices(state, spec):
+        base = get_base_reward_phase0(state, index, total_balance, spec)
+        penalties[index] += (
+            BASE_REWARDS_PER_EPOCH * base
+            - get_proposer_reward_phase0(state, index, total_balance, spec)
+        )
+        if index not in target_unslashed:
+            penalties[index] += (
+                state.validators[index].effective_balance
+                * delay
+                // spec.preset.INACTIVITY_PENALTY_QUOTIENT
+            )
+    return rewards, penalties
+
+
+def attestation_deltas_phase0(state, spec) -> dict:
+    """All five phase0 components (the rewards runner's file set)."""
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        z = _zeros(state)
+        return {k: ([0] * len(z[0]), [0] * len(z[0])) for k in (
+            "source", "target", "head", "inclusion_delay", "inactivity_penalty"
+        )}
+    caches: dict = {}
+    return {
+        "source": get_source_deltas(state, spec, caches),
+        "target": get_target_deltas(state, spec, caches),
+        "head": get_head_deltas(state, spec, caches),
+        "inclusion_delay": get_inclusion_delay_deltas(state, spec, caches),
+        "inactivity_penalty": get_inactivity_penalty_deltas_phase0(
+            state, spec, caches
+        ),
+    }
+
+
+# ------------------------------------------------------------------ altair
+
+
+def get_flag_index_deltas(state, flag_index: int, spec):
+    """Spec (altair) get_flag_index_deltas."""
+    rewards, penalties = _zeros(state)
+    prev = h.get_previous_epoch(state, spec)
+    total_balance = h.get_total_active_balance(state, spec)
+    increment = spec.preset.EFFECTIVE_BALANCE_INCREMENT
+    active_increments = total_balance // increment
+    per_increment = get_base_reward_per_increment(state, spec)
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
+    unslashed = get_unslashed_participating_indices(state, flag_index, prev, spec)
+    unslashed_increments = h.get_total_balance(state, unslashed, spec) // increment
+    leak = is_in_inactivity_leak(state, spec)
+    for index in get_eligible_validator_indices(state, spec):
+        base = _base_reward_altair(state, index, spec, per_increment)
+        if index in unslashed:
+            if not leak:
+                numerator = base * weight * unslashed_increments
+                rewards[index] += numerator // (
+                    active_increments * WEIGHT_DENOMINATOR
+                )
+        elif flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[index] += base * weight // WEIGHT_DENOMINATOR
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas_altair(state, spec):
+    """Inactivity-score-scaled penalties (altair/bellatrix quotient)."""
+    from ..types import state_fork_name
+
+    rewards, penalties = _zeros(state)
+    prev = h.get_previous_epoch(state, spec)
+    if state_fork_name(state) == "bellatrix":
+        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    else:
+        quotient = spec.preset.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    target_participants = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, prev, spec
+    )
+    for index in get_eligible_validator_indices(state, spec):
+        if index not in target_participants:
+            penalty_numerator = (
+                state.validators[index].effective_balance
+                * state.inactivity_scores[index]
+            )
+            penalties[index] += penalty_numerator // (
+                spec.INACTIVITY_SCORE_BIAS * quotient
+            )
+    return rewards, penalties
+
+
+def attestation_deltas_altair(state, spec) -> dict:
+    if h.get_current_epoch(state, spec) == GENESIS_EPOCH:
+        n = len(state.validators)
+        zero = ([0] * n, [0] * n)
+        return {"source": zero, "target": zero, "head": zero,
+                "inactivity_penalty": ([0] * n, [0] * n)}
+    return {
+        "source": get_flag_index_deltas(state, 0, spec),
+        "target": get_flag_index_deltas(state, 1, spec),
+        "head": get_flag_index_deltas(state, 2, spec),
+        "inactivity_penalty": get_inactivity_penalty_deltas_altair(state, spec),
+    }

@@ -262,16 +262,24 @@ class SignatureSet:
     signature: AggregateSignature
     signing_keys: list[PublicKey]
     message: bytes
+    # Validator indices parallel to signing_keys, when the caller knows
+    # them (signature_sets.py builders do). Purely an optimization hint:
+    # the device backend uses them to gather pubkeys from the HBM-resident
+    # table (blsrt.DevicePubkeyTable) instead of re-uploading coordinates.
+    signing_key_indices: list[int] | None = None
 
     @classmethod
-    def single_pubkey(cls, signature, signing_key: PublicKey, message: bytes):
+    def single_pubkey(cls, signature, signing_key: PublicKey, message: bytes,
+                      index: int | None = None):
         sig = signature if isinstance(signature, AggregateSignature) else AggregateSignature(signature.point)
-        return cls(sig, [signing_key], message)
+        return cls(sig, [signing_key], message,
+                   None if index is None else [index])
 
     @classmethod
-    def multiple_pubkeys(cls, signature, signing_keys: list[PublicKey], message: bytes):
+    def multiple_pubkeys(cls, signature, signing_keys: list[PublicKey],
+                         message: bytes, indices: list[int] | None = None):
         sig = signature if isinstance(signature, AggregateSignature) else AggregateSignature(signature.point)
-        return cls(sig, signing_keys, message)
+        return cls(sig, signing_keys, message, indices)
 
     def verify(self) -> bool:
         return verify_signature_sets([self])

@@ -1,0 +1,98 @@
+"""Native C++ CPU BLS backend (bls12381.cpp) — the measured baseline.
+
+Mirrors the role of the reference's milagro backend as a second real
+implementation (crypto/bls/src/impls/milagro.rs): same RLC batch semantics
+as the device path, independently coded, cross-checked in tests. It is also
+what `bench.py` measures as the honest CPU denominator (BASELINE.md: the
+baseline "must be measured, not cited") and the host fallback for
+singleton verifications where a device round-trip isn't worth it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import secrets
+
+from ..bls.backends import register_backend
+from ...native import load_lhbls
+
+
+def _pack_g1(p) -> bytes:
+    if p.infinity:
+        return bytes(96)
+    return p.x.n.to_bytes(48, "big") + p.y.n.to_bytes(48, "big")
+
+
+def _pack_g2(p) -> bytes:
+    if p.infinity:
+        return bytes(192)
+    return (
+        p.x.c0.to_bytes(48, "big") + p.x.c1.to_bytes(48, "big")
+        + p.y.c0.to_bytes(48, "big") + p.y.c1.to_bytes(48, "big")
+    )
+
+
+class NativeBackend:
+    """ctypes wrapper over lhbls_verify_batch."""
+
+    name = "native"
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def verify_signature_sets(self, sets) -> bool:
+        sets = list(sets)
+        if not sets:
+            return False
+        n = len(sets)
+        maxk = max(len(s.signing_keys) for s in sets)
+        if maxk == 0:
+            return False
+        pks = bytearray(n * maxk * 96)
+        counts = (ctypes.c_uint32 * n)()
+        sigs = bytearray(n * 192)
+        msgs = bytearray(n * 32)
+        rands = (ctypes.c_uint64 * n)()
+        for i, s in enumerate(sets):
+            counts[i] = len(s.signing_keys)
+            for k, pk in enumerate(s.signing_keys):
+                off = (i * maxk + k) * 96
+                pks[off : off + 96] = _pack_g1(pk.point)
+            sigs[i * 192 : (i + 1) * 192] = _pack_g2(s.signature.point)
+            if len(s.message) != 32:
+                raise ValueError("messages must be 32 bytes")
+            msgs[i * 32 : (i + 1) * 32] = s.message
+            r = 0
+            while r == 0:
+                r = secrets.randbits(64)
+            rands[i] = r
+        rc = self._lib.lhbls_verify_batch(
+            bytes(pks), counts, bytes(sigs), bytes(msgs), rands, n, maxk
+        )
+        return rc == 1
+
+    # ------------------------------------------------------- test helpers
+    def hash_to_g2_bytes(self, msg: bytes) -> tuple[bytes, bool]:
+        out = ctypes.create_string_buffer(192)
+        rc = self._lib.lhbls_hash_to_g2(msg, len(msg), out)
+        if rc < 0:
+            raise RuntimeError(f"lhbls_hash_to_g2 rc={rc}")
+        return out.raw, rc == 1
+
+    def pairing_bytes(self, g1_96: bytes, g2_192: bytes) -> bytes:
+        out = ctypes.create_string_buffer(576)
+        rc = self._lib.lhbls_pairing(g1_96, g2_192, out)
+        if rc != 0:
+            raise RuntimeError(f"lhbls_pairing rc={rc}")
+        return out.raw
+
+
+def load_native_backend():
+    """Build/load the native library and register the backend; returns the
+    backend or None when the toolchain is unavailable."""
+    lib = load_lhbls()
+    if lib is None:
+        return None
+    backend = NativeBackend(lib)
+    register_backend("native", backend)
+    return backend

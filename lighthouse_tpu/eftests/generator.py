@@ -392,6 +392,450 @@ def _gen_fork_and_genesis(root: str) -> None:
     _write_yaml(os.path.join(d, "is_valid.yaml"), False)
 
 
+def _gen_epoch_and_rewards(root: str) -> None:
+    """Every epoch_processing sub-transition + the rewards component
+    deltas, phase0 and altair (reference runners: epoch_processing,
+    rewards)."""
+    import dataclasses
+
+    from ..consensus.config import minimal_spec
+    from ..consensus.transition import epoch as ep
+    from ..consensus.transition.rewards import (
+        attestation_deltas_altair,
+        attestation_deltas_phase0,
+    )
+    from ..consensus.transition.slot import process_slots
+    from ..consensus.transition.upgrade import upgrade_to_altair
+    from .handlers import EpochProcessing, _deltas_container
+
+    Deltas = _deltas_container()
+    spec = minimal_spec()
+    h = BeaconChainHarness(validator_count=16, backend="python")
+    h.extend_chain(2 * spec.preset.SLOTS_PER_EPOCH - 1)
+    base = h.chain.head().state.copy()
+    boundary = (
+        int(base.slot) // spec.preset.SLOTS_PER_EPOCH + 1
+    ) * spec.preset.SLOTS_PER_EPOCH
+    p0 = process_slots(base, boundary - 1, spec)
+
+    altair_spec = dataclasses.replace(spec, ALTAIR_FORK_EPOCH=0)
+    a0 = upgrade_to_altair(p0.copy(), altair_spec)
+
+    _P0_ONLY = {"participation_record_updates"}
+    _ALTAIR_ONLY = {
+        "inactivity_updates", "participation_flag_updates",
+        "sync_committee_updates",
+    }
+
+    def run_sub(state, sub, fork, sp):
+        post = state.copy()
+        if sub == "justification_and_finalization":
+            if fork == "phase0":
+                ep.process_justification_and_finalization_phase0(post, sp)
+            else:
+                ep.process_justification_and_finalization_altair(post, sp)
+        elif sub == "rewards_and_penalties":
+            if fork == "phase0":
+                ep.process_rewards_and_penalties_phase0(post, sp)
+            else:
+                ep.process_rewards_and_penalties_altair(post, sp)
+        elif sub == "participation_record_updates":
+            ep.process_participation_record_updates(post)
+        else:
+            getattr(ep, f"process_{sub}")(post, sp)
+        return post
+
+    for fork, state, sp in (("phase0", p0, spec), ("altair", a0, altair_spec)):
+        for sub in EpochProcessing.SUBS:
+            if fork == "phase0" and sub in _ALTAIR_ONLY:
+                continue
+            if fork == "altair" and sub in _P0_ONLY:
+                continue
+            post = run_sub(state, sub, fork, sp)
+            d = _case(root, "minimal", fork, "epoch_processing", sub,
+                      "pyspec_tests", "case_0")
+            _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), state.encode())
+            _write_ssz_snappy(os.path.join(d, "post.ssz_snappy"), post.encode())
+
+        deltas = (
+            attestation_deltas_phase0(state, sp)
+            if fork == "phase0"
+            else attestation_deltas_altair(state, sp)
+        )
+        d = _case(root, "minimal", fork, "rewards", "basic",
+                  "pyspec_tests", "case_0")
+        _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), state.encode())
+        for name, (rewards, penalties) in deltas.items():
+            obj = Deltas(rewards=rewards, penalties=penalties)
+            _write_ssz_snappy(
+                os.path.join(d, f"{name}_deltas.ssz_snappy"), obj.encode()
+            )
+
+
+def _gen_transition(root: str) -> None:
+    """Blocks crossing the phase0 -> altair boundary (reference runner:
+    transition)."""
+    import dataclasses
+
+    from ..consensus.config import minimal_spec
+
+    spec = dataclasses.replace(minimal_spec(), ALTAIR_FORK_EPOCH=1)
+    h = BeaconChainHarness(validator_count=16, backend="python", spec=spec)
+    pre = h.chain.head().state.copy()
+    epoch_slots = spec.preset.SLOTS_PER_EPOCH
+    blocks = []
+    for _ in range(epoch_slots + 2):  # cross the epoch-1 boundary
+        slot = h.advance_slot()
+        block = h.make_block(slot)
+        h.chain.process_block(block)
+        blocks.append(block)
+    fork_block = sum(
+        1 for b in blocks if int(b.message.slot) < epoch_slots
+    ) - 1
+    d = _case(root, "minimal", "altair", "transition", "core",
+              "pyspec_tests", "simple_transition")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), pre.encode())
+    _write_yaml(os.path.join(d, "meta.yaml"), {
+        "post_fork": "altair",
+        "fork_epoch": 1,
+        "blocks_count": len(blocks),
+        "fork_block": fork_block,
+    })
+    for i, b in enumerate(blocks):
+        _write_ssz_snappy(os.path.join(d, f"blocks_{i}.ssz_snappy"), b.encode())
+    _write_ssz_snappy(
+        os.path.join(d, "post.ssz_snappy"), h.chain.head().state.encode()
+    )
+
+
+def _gen_fork_choice(root: str) -> None:
+    """Step-driven fork-choice vectors from a harness chain (reference
+    runner: fork_choice/{get_head,on_block})."""
+    from ..consensus.types import spec_types
+
+    h = BeaconChainHarness(validator_count=16, backend="python")
+    spec = h.spec
+    t = spec_types(spec.preset)
+    anchor_state = h.chain.head().state.copy()
+    anchor_block = h.chain.head().block.message  # the genesis block
+
+    genesis_time = int(anchor_state.genesis_time)
+    steps = []
+    blocks = []
+    for _ in range(3):
+        slot = h.advance_slot()
+        block = h.make_block(slot)
+        h.chain.process_block(block)
+        blocks.append(block)
+        steps.append({"tick": genesis_time + slot * spec.SECONDS_PER_SLOT})
+        steps.append({"block": f"block_{len(blocks) - 1}"})
+    head = h.chain.head()
+    steps.append({
+        "checks": {
+            "head": {
+                "slot": int(head.block.message.slot),
+                "root": "0x" + head.root.hex(),
+            }
+        }
+    })
+
+    def write(case, extra_steps, sub):
+        d = _case(root, "minimal", "phase0", "fork_choice", sub,
+                  "pyspec_tests", case)
+        _write_ssz_snappy(
+            os.path.join(d, "anchor_state.ssz_snappy"), anchor_state.encode()
+        )
+        _write_ssz_snappy(
+            os.path.join(d, "anchor_block.ssz_snappy"), anchor_block.encode()
+        )
+        for i, b in enumerate(blocks):
+            _write_ssz_snappy(
+                os.path.join(d, f"block_{i}.ssz_snappy"), b.encode()
+            )
+        _write_yaml(os.path.join(d, "steps.yaml"), extra_steps)
+
+    write("chain_of_blocks", steps, "get_head")
+
+    # on_block: a block whose slot is ahead of the tick must be rejected.
+    future = [
+        {"tick": genesis_time},  # time stays at genesis
+        {"block": "block_0", "valid": False},
+    ]
+    write("future_block", future, "on_block")
+
+
+def _gen_ssz_generic(root: str) -> None:
+    """ssz_generic valid/invalid vectors named per the official
+    conventions (reference runner: ssz_generic)."""
+    from ..consensus.ssz import Bitlist, Bitvector, Boolean, Uint, Vector
+    from .handlers import _ssz_test_container
+
+    def write(handler, suite, name, raw, schema=None, value=None):
+        d = _case(root, "general", "phase0", "ssz_generic", handler,
+                  suite, name)
+        _write_ssz_snappy(os.path.join(d, "serialized.ssz_snappy"), raw)
+        if suite == "valid":
+            root_hex = (
+                value.hash_tree_root()
+                if hasattr(value, "hash_tree_root")
+                else schema.hash_tree_root(value)
+            ).hex()
+            _write_yaml(os.path.join(d, "meta.yaml"), {"root": "0x" + root_hex})
+
+    # uints
+    for bits, v in ((8, 0x7F), (16, 0xABCD), (32, 0xDEADBEEF),
+                    (64, 2**63 + 17), (128, 2**100 + 5), (256, 2**200 + 9)):
+        sch = Uint(bits // 8)
+        write("uints", "valid", f"uint_{bits}_random", sch.encode(v),
+              sch, v)
+        write("uints", "invalid", f"uint_{bits}_one_byte_longer",
+              sch.encode(v) + b"\x00")
+    # boolean
+    write("boolean", "valid", "true", b"\x01", Boolean(), True)
+    write("boolean", "valid", "false", b"\x00", Boolean(), False)
+    write("boolean", "invalid", "byte_2", b"\x02")
+    # basic_vector
+    sch = Vector(Uint(8), 64)
+    v = [3] * 64
+    write("basic_vector", "valid", "vec_uint64_64_filled",
+          Vector(Uint(8), 64).encode(v), sch, v)
+    write("basic_vector", "invalid", "vec_uint64_64_one_less",
+          Vector(Uint(8), 64).encode(v)[:-8])
+    # bitvector
+    sch = Bitvector(9)
+    bv = [True, False] * 4 + [True]
+    write("bitvector", "valid", "bitvec_9_random", sch.encode(bv), sch, bv)
+    write("bitvector", "invalid", "bitvec_9_extra_bit",
+          bytes([0xFF, 0xFF]))  # bit above length 9 set
+    # bitlist
+    sch = Bitlist(8)
+    bl = [True, True, False, True]
+    write("bitlist", "valid", "bitlist_8_random", sch.encode(bl), sch, bl)
+    write("bitlist", "invalid", "bitlist_8_no_delimiter", b"\x00")
+    # containers
+    for name, kwargs in (
+        ("SingleFieldTestStruct", {"A": 0xAB}),
+        ("SmallTestStruct", {"A": 0x1122, "B": 0x3344}),
+        ("FixedTestStruct", {"A": 7, "B": 2**40, "C": 0xDDCCBBAA}),
+        ("VarTestStruct", {"A": 45, "B": [1, 2, 3], "C": 9}),
+        ("BitsStruct", {
+            "A": [True, False, True],
+            "B": [True, True],
+            "C": [False],
+            "D": [True] * 6,
+            "E": [False, True] * 4,
+        }),
+    ):
+        cls = _ssz_test_container(name)
+        obj = cls(**kwargs)
+        write("containers", "valid", f"{name}_valid", obj.encode(),
+              value=obj)
+        write("containers", "invalid", f"{name}_truncated",
+              obj.encode()[:-1] if len(obj.encode()) > 1 else b"")
+
+
+def _gen_ssz_static_breadth(root: str) -> None:
+    """One vector per spec container the ssz_static runner names
+    (reference runner: ssz_static over every type)."""
+    import dataclasses
+
+    from ..consensus import types as ct
+    from ..consensus.config import minimal_spec
+    from ..consensus.types import spec_types
+
+    h = BeaconChainHarness(validator_count=16, backend="python")
+    spec = h.spec
+    t = spec_types(spec.preset)
+    slot = h.advance_slot()
+    block = h.make_block(slot)
+    h.chain.process_block(block)
+    atts = [v.attestation for v in h.attest(slot)]
+    att = atts[0]
+    state = h.chain.head().state
+
+    indexed = __import__(
+        "lighthouse_tpu.consensus.helpers", fromlist=["get_indexed_attestation"]
+    ).get_indexed_attestation(state, att, spec)
+
+    objs = {
+        "Attestation": att,
+        "AttestationData": att.data,
+        "AttesterSlashing": ct.AttesterSlashing(
+            attestation_1=indexed, attestation_2=indexed
+        ),
+        "BeaconBlockHeader": ct.BeaconBlockHeader(
+            slot=1, proposer_index=2, parent_root=b"\x01" * 32,
+            state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+        ),
+        "Checkpoint": att.data.target,
+        "DepositData": ct.DepositData(
+            pubkey=b"\x11" * 48, withdrawal_credentials=b"\x22" * 32,
+            amount=32 * 10**9, signature=b"\x33" * 96,
+        ),
+        "DepositMessage": ct.DepositMessage(
+            pubkey=b"\x11" * 48, withdrawal_credentials=b"\x22" * 32,
+            amount=32 * 10**9,
+        ),
+        "Eth1Data": state.eth1_data,
+        "Fork": state.fork,
+        "ForkData": ct.ForkData(
+            current_version=b"\x00\x00\x00\x01",
+            genesis_validators_root=b"\x42" * 32,
+        ),
+        "IndexedAttestation": indexed,
+        "PendingAttestation": ct.PendingAttestation(
+            aggregation_bits=att.aggregation_bits, data=att.data,
+            inclusion_delay=1, proposer_index=0,
+        ),
+        "SignedBeaconBlockHeader": ct.SignedBeaconBlockHeader(
+            message=ct.BeaconBlockHeader(
+                slot=1, proposer_index=2, parent_root=b"\x01" * 32,
+                state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+            ),
+            signature=b"\x44" * 96,
+        ),
+        "SigningData": ct.SigningData(
+            object_root=b"\x55" * 32, domain=b"\x66" * 32
+        ),
+        "Validator": state.validators[0],
+        "VoluntaryExit": ct.VoluntaryExit(epoch=3, validator_index=4),
+        "SignedVoluntaryExit": ct.SignedVoluntaryExit(
+            message=ct.VoluntaryExit(epoch=3, validator_index=4),
+            signature=b"\x77" * 96,
+        ),
+    }
+    # Deposit carries a Vector[Bytes32, 33] proof.
+    objs["Deposit"] = ct.Deposit(
+        proof=[bytes([i]) * 32 for i in range(33)], data=objs["DepositData"]
+    )
+    # ProposerSlashing from two signed headers.
+    objs["ProposerSlashing"] = ct.ProposerSlashing(
+        signed_header_1=objs["SignedBeaconBlockHeader"],
+        signed_header_2=objs["SignedBeaconBlockHeader"],
+    )
+    objs["HistoricalBatch"] = t.HistoricalBatch(
+        block_roots=list(state.block_roots), state_roots=list(state.state_roots)
+    )
+    for name, obj in objs.items():
+        d = _case(root, "minimal", "phase0", "ssz_static", name,
+                  "ssz_random", "case_0")
+        _write_ssz_snappy(os.path.join(d, "serialized.ssz_snappy"), obj.encode())
+        _write_yaml(os.path.join(d, "roots.yaml"),
+                    {"root": "0x" + obj.hash_tree_root().hex()})
+
+    # altair/bellatrix containers under their fork dirs
+    sync_agg = t.SyncAggregate(
+        sync_committee_bits=[True] * spec.preset.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=b"\x88" * 96,
+    )
+    sync_comm = t.SyncCommittee(
+        pubkeys=[b"\x11" * 48] * spec.preset.SYNC_COMMITTEE_SIZE,
+        aggregate_pubkey=b"\x11" * 48,
+    )
+    for name, obj in (("SyncAggregate", sync_agg), ("SyncCommittee", sync_comm)):
+        d = _case(root, "minimal", "altair", "ssz_static", name,
+                  "ssz_random", "case_0")
+        _write_ssz_snappy(os.path.join(d, "serialized.ssz_snappy"), obj.encode())
+        _write_yaml(os.path.join(d, "roots.yaml"),
+                    {"root": "0x" + obj.hash_tree_root().hex()})
+
+    payload = t.ExecutionPayload(
+        parent_hash=b"\x01" * 32, fee_recipient=b"\x02" * 20,
+        state_root=b"\x03" * 32, receipts_root=b"\x04" * 32,
+        logs_bloom=b"\x00" * 256, prev_randao=b"\x05" * 32,
+        block_number=7, gas_limit=30_000_000, gas_used=21_000,
+        timestamp=12, extra_data=b"hi", base_fee_per_gas=10**9,
+        block_hash=b"\x06" * 32, transactions=[b"\xaa\xbb"],
+    )
+    header_fields = {
+        k: getattr(payload, k)
+        for k in t.ExecutionPayloadHeader.fields
+        if k != "transactions_root"
+    }
+    tx_schema = t.ExecutionPayload.fields["transactions"]
+    header = t.ExecutionPayloadHeader(
+        **header_fields,
+        transactions_root=tx_schema.hash_tree_root(payload.transactions),
+    )
+    for name, obj in (
+        ("ExecutionPayload", payload), ("ExecutionPayloadHeader", header),
+    ):
+        d = _case(root, "minimal", "bellatrix", "ssz_static", name,
+                  "ssz_random", "case_0")
+        _write_ssz_snappy(os.path.join(d, "serialized.ssz_snappy"), obj.encode())
+        _write_yaml(os.path.join(d, "roots.yaml"),
+                    {"root": "0x" + obj.hash_tree_root().hex()})
+
+
+def _gen_execution_payload_op(root: str) -> None:
+    """operations/execution_payload vectors on a pre-merge bellatrix
+    state (reference: operations.rs execution_payload)."""
+    import dataclasses
+
+    from ..consensus import helpers as ch
+    from ..consensus.config import minimal_spec
+    from ..consensus.transition.block import (
+        compute_timestamp_at_slot,
+        process_execution_payload,
+    )
+    from ..consensus.transition.upgrade import (
+        upgrade_to_altair,
+        upgrade_to_bellatrix,
+    )
+    from ..consensus.types import spec_types
+
+    spec = dataclasses.replace(
+        minimal_spec(), ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0
+    )
+    t = spec_types(spec.preset)
+    h = BeaconChainHarness(validator_count=16, backend="python")
+    state = upgrade_to_bellatrix(
+        upgrade_to_altair(h.chain.head().state.copy(), spec), spec
+    )
+
+    randao = ch.get_randao_mix(
+        state, ch.get_current_epoch(state, spec), spec
+    )
+    payload = t.ExecutionPayload(
+        parent_hash=b"\x01" * 32, fee_recipient=b"\x02" * 20,
+        state_root=b"\x03" * 32, receipts_root=b"\x04" * 32,
+        logs_bloom=b"\x00" * 256, prev_randao=bytes(randao),
+        block_number=1, gas_limit=30_000_000, gas_used=0,
+        timestamp=compute_timestamp_at_slot(state, int(state.slot), spec),
+        extra_data=b"", base_fee_per_gas=10**9,
+        block_hash=b"\x06" * 32, transactions=[],
+    )
+    post = state.copy()
+    process_execution_payload(post, payload, spec)
+
+    d = _case(root, "minimal", "bellatrix", "operations",
+              "execution_payload", "pyspec_tests", "valid")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), state.encode())
+    _write_ssz_snappy(
+        os.path.join(d, "execution_payload.ssz_snappy"), payload.encode()
+    )
+    _write_yaml(os.path.join(d, "execution.yaml"), {"execution_valid": True})
+    _write_ssz_snappy(os.path.join(d, "post.ssz_snappy"), post.encode())
+
+    bad = payload.copy()
+    bad.timestamp = int(payload.timestamp) + 1
+    d = _case(root, "minimal", "bellatrix", "operations",
+              "execution_payload", "pyspec_tests", "invalid_timestamp")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), state.encode())
+    _write_ssz_snappy(
+        os.path.join(d, "execution_payload.ssz_snappy"), bad.encode()
+    )
+    _write_yaml(os.path.join(d, "execution.yaml"), {"execution_valid": True})
+
+    d = _case(root, "minimal", "bellatrix", "operations",
+              "execution_payload", "pyspec_tests", "engine_rejects")
+    _write_ssz_snappy(os.path.join(d, "pre.ssz_snappy"), state.encode())
+    _write_ssz_snappy(
+        os.path.join(d, "execution_payload.ssz_snappy"), payload.encode()
+    )
+    _write_yaml(os.path.join(d, "execution.yaml"), {"execution_valid": False})
+
+
 def generate_vectors(root: str) -> int:
     """Write the full tree; returns number of case directories."""
     from ..consensus.config import minimal_spec
@@ -400,6 +844,12 @@ def generate_vectors(root: str) -> int:
     _gen_shuffling(root, minimal_spec())
     _gen_state_vectors(root)
     _gen_fork_and_genesis(root)
+    _gen_epoch_and_rewards(root)
+    _gen_transition(root)
+    _gen_fork_choice(root)
+    _gen_ssz_generic(root)
+    _gen_ssz_static_breadth(root)
+    _gen_execution_payload_op(root)
     count = 0
     for dirpath, dirnames, filenames in os.walk(os.path.join(root, "tests")):
         if filenames and not dirnames:

@@ -255,6 +255,7 @@ _OP_FILES = {
     "deposit": ("deposit.ssz_snappy", "Deposit"),
     "block_header": ("block.ssz_snappy", None),
     "sync_aggregate": ("sync_aggregate.ssz_snappy", "SyncAggregate"),
+    "execution_payload": ("execution_payload.ssz_snappy", "ExecutionPayload"),
 }
 
 
@@ -306,6 +307,14 @@ class Operations(Handler):
                 blk.process_block_header(pre, op, spec)
             elif self.handler == "sync_aggregate":
                 blk.process_sync_aggregate(pre, op, spec, col, get_pubkey)
+            elif self.handler == "execution_payload":
+                # execution.yaml carries the mocked engine verdict
+                # (reference: operations.rs execution_payload handler).
+                exe = _read_yaml(os.path.join(case_dir, "execution.yaml")) or {}
+                valid = bool(exe.get("execution_valid", True))
+                blk.process_execution_payload(
+                    pre, op, spec, notify_new_payload=lambda _p: valid
+                )
             col.finish()
 
         if expect_success:
@@ -395,6 +404,19 @@ class EpochProcessing(Handler):
     def __init__(self, sub: str):
         self.handler = sub
 
+    # Every per-fork sub-transition the reference's epoch_processing
+    # handler family covers (testing/ef_tests/src/cases/epoch_processing.rs).
+    SUBS = (
+        "justification_and_finalization", "rewards_and_penalties",
+        "registry_updates", "slashings", "eth1_data_reset",
+        "effective_balance_updates", "slashings_reset",
+        "randao_mixes_reset", "historical_roots_update",
+        "participation_record_updates",      # phase0 only
+        "inactivity_updates",                # altair+
+        "participation_flag_updates",        # altair+
+        "sync_committee_updates",            # altair+
+    )
+
     def run_case(self, case_dir, config, fork):
         from ..consensus.transition import epoch as ep
 
@@ -402,17 +424,32 @@ class EpochProcessing(Handler):
         state_cls = _state_cls(config, fork)
         pre = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy")))
         post = state_cls.decode(_read_ssz_snappy(os.path.join(case_dir, "post.ssz_snappy")))
-        if self.handler == "justification_and_finalization":
+        h = self.handler
+        if h == "justification_and_finalization":
             if fork == "phase0":
                 ep.process_justification_and_finalization_phase0(pre, spec)
             else:
                 ep.process_justification_and_finalization_altair(pre, spec)
+        elif h == "rewards_and_penalties":
+            if fork == "phase0":
+                ep.process_rewards_and_penalties_phase0(pre, spec)
+            else:
+                ep.process_rewards_and_penalties_altair(pre, spec)
+        elif h == "participation_record_updates":
+            ep.process_participation_record_updates(pre)
         else:
             fn = {
                 "registry_updates": ep.process_registry_updates,
                 "slashings": ep.process_slashings,
+                "eth1_data_reset": ep.process_eth1_data_reset,
                 "effective_balance_updates": ep.process_effective_balance_updates,
-            }[self.handler]
+                "slashings_reset": ep.process_slashings_reset,
+                "randao_mixes_reset": ep.process_randao_mixes_reset,
+                "historical_roots_update": ep.process_historical_roots_update,
+                "inactivity_updates": ep.process_inactivity_updates,
+                "participation_flag_updates": ep.process_participation_flag_updates,
+                "sync_committee_updates": ep.process_sync_committee_updates,
+            }[h]
             fn(pre, spec)
         assert pre.hash_tree_root() == post.hash_tree_root()
 
@@ -516,6 +553,344 @@ class GenesisValidity(Handler):
         assert is_valid_genesis_state(state, spec) == want
 
 
+# ------------------------------------------------------------ rewards runner
+def _deltas_container():
+    from ..consensus.ssz import Container, List as SszList, uint64
+
+    class Deltas(Container):
+        fields = {
+            "rewards": SszList(uint64, 2**40),
+            "penalties": SszList(uint64, 2**40),
+        }
+
+    return Deltas
+
+
+class Rewards(Handler):
+    """Per-component reward/penalty deltas vs Deltas ssz files
+    (reference: cases/rewards.rs). phase0 checks five components,
+    altair+ four (no inclusion_delay)."""
+
+    runner = "rewards"
+
+    def __init__(self, sub: str):
+        self.handler = sub
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.transition.rewards import (
+            attestation_deltas_altair,
+            attestation_deltas_phase0,
+        )
+
+        Deltas = _deltas_container()
+        spec = _spec_for(config, fork)
+        pre = _state_cls(config, fork).decode(
+            _read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"))
+        )
+        got = (
+            attestation_deltas_phase0(pre, spec)
+            if fork == "phase0"
+            else attestation_deltas_altair(pre, spec)
+        )
+        for name, (rewards, penalties) in got.items():
+            path = os.path.join(case_dir, f"{name}_deltas.ssz_snappy")
+            want = Deltas.decode(_read_ssz_snappy(path))
+            assert list(want.rewards) == rewards, f"{name} rewards"
+            assert list(want.penalties) == penalties, f"{name} penalties"
+
+
+# --------------------------------------------------------- transition runner
+class Transition(Handler):
+    """Blocks crossing a fork boundary: pre-fork blocks under the old
+    rules, the upgrade at fork_epoch, post-fork blocks under the new
+    (reference: cases/transition.rs)."""
+
+    runner = "transition"
+    handler = "core"
+
+    _PREV = {"altair": "phase0", "bellatrix": "altair"}
+
+    def run_case(self, case_dir, config, fork):
+        import dataclasses
+
+        from ..consensus.transition.block import (
+            SignatureStrategy,
+            per_block_processing,
+        )
+        from ..consensus.transition.slot import process_slots
+
+        meta = _read_yaml(os.path.join(case_dir, "meta.yaml"))
+        post_fork = meta["post_fork"]
+        fork_epoch = int(meta["fork_epoch"])
+        count = int(meta["blocks_count"])
+        fork_block = meta.get("fork_block")  # index of last pre-fork block
+
+        spec = _spec_for(config, self._PREV[post_fork])
+        spec = dataclasses.replace(
+            spec,
+            ALTAIR_FORK_EPOCH=(
+                fork_epoch if post_fork == "altair" else 0
+            ),
+            BELLATRIX_FORK_EPOCH=(
+                fork_epoch if post_fork == "bellatrix" else None
+            ),
+        )
+        t = spec_types(spec.preset)
+        pre_fork = self._PREV[post_fork]
+        state = t.STATE_BY_FORK[pre_fork].decode(
+            _read_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"))
+        )
+
+        for i in range(count):
+            pre_side = fork_block is not None and i <= int(fork_block)
+            blk_fork = pre_fork if pre_side else post_fork
+            raw = _read_ssz_snappy(
+                os.path.join(case_dir, f"blocks_{i}.ssz_snappy")
+            )
+            block = t.SIGNED_BLOCK_BY_FORK[blk_fork].decode(raw)
+            if int(state.slot) < int(block.message.slot):
+                # process_slots applies the scheduled fork upgrade at the
+                # boundary (transition/slot.py _maybe_upgrade)
+                state = process_slots(state, int(block.message.slot), spec)
+            per_block_processing(
+                state, block, spec, strategy=SignatureStrategy.VERIFY_BULK
+            )
+        want = _read_ssz_snappy(os.path.join(case_dir, "post.ssz_snappy"))
+        assert state.encode() == want, "transition post-state mismatch"
+
+
+# -------------------------------------------------------- fork_choice runner
+class ForkChoiceHandler(Handler):
+    """Step-driven fork-choice vectors: anchor + {tick, block,
+    attestation, checks} steps (reference: cases/fork_choice.rs)."""
+
+    runner = "fork_choice"
+
+    def __init__(self, sub: str):
+        self.handler = sub
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus import helpers as ch
+        from ..consensus.transition.block import (
+            SignatureStrategy,
+            per_block_processing,
+        )
+        from ..consensus.transition.slot import process_slots
+        from ..forkchoice.fork_choice import ForkChoice, ForkChoiceError
+
+        spec = _spec_for(config, fork)
+        t = spec_types(spec.preset)
+        state_cls = _state_cls(config, fork)
+        anchor_state = state_cls.decode(
+            _read_ssz_snappy(os.path.join(case_dir, "anchor_state.ssz_snappy"))
+        )
+        anchor_block = t.BLOCK_BY_FORK[fork].decode(
+            _read_ssz_snappy(os.path.join(case_dir, "anchor_block.ssz_snappy"))
+        )
+        anchor_root = anchor_block.hash_tree_root()
+        fc = ForkChoice.from_anchor(anchor_state, anchor_root, spec)
+        states = {anchor_root: anchor_state}
+        genesis_time = int(anchor_state.genesis_time)
+        current_slot = int(anchor_state.slot)
+
+        steps = _read_yaml(os.path.join(case_dir, "steps.yaml"))
+        for step in steps:
+            if "tick" in step:
+                current_slot = (
+                    int(step["tick"]) - genesis_time
+                ) // spec.SECONDS_PER_SLOT
+                fc.update_time(current_slot)
+            elif "block" in step:
+                raw = _read_ssz_snappy(
+                    os.path.join(case_dir, f"{step['block']}.ssz_snappy")
+                )
+                signed = t.SIGNED_BLOCK_BY_FORK[fork].decode(raw)
+                expect_valid = step.get("valid", True)
+                try:
+                    parent = states[bytes(signed.message.parent_root)].copy()
+                    if int(parent.slot) < int(signed.message.slot):
+                        parent = process_slots(
+                            parent, int(signed.message.slot), spec
+                        )
+                    per_block_processing(
+                        parent, signed, spec,
+                        strategy=SignatureStrategy.VERIFY_BULK,
+                    )
+                    root = signed.message.hash_tree_root()
+                    fc.on_block(current_slot, signed.message, root, parent)
+                except Exception:
+                    if expect_valid:
+                        raise
+                    continue
+                assert expect_valid, "expected on_block rejection"
+                states[root] = parent
+            elif "attestation" in step:
+                raw = _read_ssz_snappy(
+                    os.path.join(case_dir, f"{step['attestation']}.ssz_snappy")
+                )
+                att = t.Attestation.decode(raw)
+                st = states.get(bytes(att.data.beacon_block_root))
+                indexed = ch.get_indexed_attestation(st, att, spec)
+                expect_valid = step.get("valid", True)
+                try:
+                    fc.on_attestation(current_slot, indexed)
+                except ForkChoiceError:
+                    if expect_valid:
+                        raise
+                    continue
+                assert expect_valid, "expected on_attestation rejection"
+            elif "checks" in step:
+                checks = step["checks"]
+                if "head" in checks:
+                    head = fc.get_head(current_slot)
+                    assert head == _hex(checks["head"]["root"]), "head root"
+                    hb = fc.get_block(head)
+                    assert hb.slot == int(checks["head"]["slot"]), "head slot"
+                if "justified_checkpoint" in checks:
+                    cp = checks["justified_checkpoint"]
+                    assert fc.store.justified_checkpoint == (
+                        int(cp["epoch"]), _hex(cp["root"])
+                    ), "justified checkpoint"
+                if "finalized_checkpoint" in checks:
+                    cp = checks["finalized_checkpoint"]
+                    assert fc.store.finalized_checkpoint == (
+                        int(cp["epoch"]), _hex(cp["root"])
+                    ), "finalized checkpoint"
+
+
+# --------------------------------------------------------- ssz_generic runner
+def _ssz_generic_schema(handler: str, case_name: str):
+    """Schema from the official case-name conventions
+    (reference: cases/ssz_generic.rs type_name parsing)."""
+    from ..consensus.ssz import (
+        Bitlist,
+        Bitvector,
+        Boolean,
+        Uint,
+        Vector,
+    )
+
+    parts = case_name.split("_")
+    if handler == "uints":
+        # uint_{bits}_{...}
+        return Uint(int(parts[1]) // 8)
+    if handler == "boolean":
+        return Boolean()
+    if handler == "bitvector":
+        # bitvec_{n}_{...}
+        return Bitvector(int(parts[1]))
+    if handler == "bitlist":
+        # bitlist_{n}_{...}
+        return Bitlist(int(parts[1]))
+    if handler == "basic_vector":
+        # vec_{elem}_{n}_{...}
+        elem = {
+            "bool": Boolean(),
+            "uint8": Uint(1), "uint16": Uint(2), "uint32": Uint(4),
+            "uint64": Uint(8), "uint128": Uint(16), "uint256": Uint(32),
+        }[parts[1]]
+        return Vector(elem, int(parts[2]))
+    if handler == "containers":
+        return _ssz_test_container(parts[0]).schema
+    raise KeyError(handler)
+
+
+_SSZ_TEST_CONTAINERS: dict = {}
+
+
+def _ssz_test_container(name: str):
+    """The spec's ssz_generic test containers (SingleFieldTestStruct &
+    co., reference: cases/ssz_generic.rs:20-80)."""
+    if _SSZ_TEST_CONTAINERS:
+        return _SSZ_TEST_CONTAINERS[name]
+    from ..consensus.ssz import (
+        Bitlist,
+        Bitvector,
+        Container,
+        List as SszList,
+        Uint,
+        Vector,
+    )
+
+    u8, u16, u32, u64 = Uint(1), Uint(2), Uint(4), Uint(8)
+
+    class SingleFieldTestStruct(Container):
+        fields = {"A": u8}
+
+    class SmallTestStruct(Container):
+        fields = {"A": u16, "B": u16}
+
+    class FixedTestStruct(Container):
+        fields = {"A": u8, "B": u64, "C": u32}
+
+    class VarTestStruct(Container):
+        fields = {"A": u16, "B": SszList(u16, 1024), "C": u8}
+
+    class ComplexTestStruct(Container):
+        fields = {
+            "A": u16,
+            "B": SszList(u16, 128),
+            "C": u8,
+            "D": SszList(u8, 256),
+            "E": VarTestStruct.schema,
+            "F": Vector(FixedTestStruct.schema, 4),
+            "G": Vector(VarTestStruct.schema, 2),
+        }
+
+    class BitsStruct(Container):
+        fields = {
+            "A": Bitlist(5),
+            "B": Bitvector(2),
+            "C": Bitvector(1),
+            "D": Bitlist(6),
+            "E": Bitvector(8),
+        }
+
+    _SSZ_TEST_CONTAINERS.update({
+        "SingleFieldTestStruct": SingleFieldTestStruct,
+        "SmallTestStruct": SmallTestStruct,
+        "FixedTestStruct": FixedTestStruct,
+        "VarTestStruct": VarTestStruct,
+        "ComplexTestStruct": ComplexTestStruct,
+        "BitsStruct": BitsStruct,
+    })
+    return _SSZ_TEST_CONTAINERS[name]
+
+
+class SszGeneric(Handler):
+    """valid/ cases must round-trip and match the recorded root; invalid/
+    cases must fail to decode (reference: cases/ssz_generic.rs)."""
+
+    runner = "ssz_generic"
+
+    def __init__(self, sub: str):
+        self.handler = sub
+
+    def run_case(self, case_dir, config, fork):
+        from ..consensus.ssz import SszError
+
+        suite = os.path.basename(os.path.dirname(case_dir))
+        name = os.path.basename(case_dir)
+        schema = _ssz_generic_schema(self.handler, name)
+        raw = _read_ssz_snappy(os.path.join(case_dir, "serialized.ssz_snappy"))
+        if suite == "invalid":
+            try:
+                schema.decode(raw)
+            except (SszError, ValueError, IndexError):
+                return
+            raise AssertionError("invalid case decoded successfully")
+        obj = schema.decode(raw)
+        enc = obj.encode() if hasattr(obj, "encode") else schema.encode(obj)
+        assert enc == raw, "re-serialization mismatch"
+        meta = _read_yaml(os.path.join(case_dir, "meta.yaml"))
+        root = (
+            obj.hash_tree_root()
+            if hasattr(obj, "hash_tree_root")
+            else schema.hash_tree_root(obj)
+        )
+        assert root == _hex(meta["root"])
+
+
 # -------------------------------------------------------------------- driver
 def default_handlers() -> list[Handler]:
     hs: list[Handler] = [
@@ -526,15 +901,28 @@ def default_handlers() -> list[Handler]:
         SanitySlots(), SanityBlocks(),
     ]
     hs += [Operations(op) for op in _OP_FILES]
+    hs += [EpochProcessing(s) for s in EpochProcessing.SUBS]
     hs += [
-        EpochProcessing(s)
-        for s in (
-            "justification_and_finalization", "registry_updates",
-            "slashings", "effective_balance_updates",
+        SszStatic(n)
+        for n in (
+            "Attestation", "AttestationData", "AttesterSlashing",
+            "BeaconBlockHeader", "Checkpoint", "Deposit", "DepositData",
+            "DepositMessage", "Eth1Data", "Fork", "ForkData",
+            "HistoricalBatch", "IndexedAttestation", "PendingAttestation",
+            "ProposerSlashing", "SignedBeaconBlockHeader",
+            "SignedVoluntaryExit", "SigningData", "SyncAggregate",
+            "SyncCommittee", "Validator", "VoluntaryExit",
+            "ExecutionPayload", "ExecutionPayloadHeader",
         )
     ]
-    hs += [SszStatic(n) for n in ("Attestation", "AttestationData", "Checkpoint")]
     hs += [Fork(), GenesisInitialization(), GenesisValidity()]
+    hs += [Rewards("basic"), Transition(), ForkChoiceHandler("get_head"),
+           ForkChoiceHandler("on_block")]
+    hs += [
+        SszGeneric(s)
+        for s in ("uints", "boolean", "basic_vector", "bitvector",
+                  "bitlist", "containers")
+    ]
     return hs
 
 

@@ -25,18 +25,22 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _compile(src_name: str, stem: str, extra_flags: tuple = ()) -> str:
-    """Build `src_name` into a content-hash-keyed shared library."""
-    src = os.path.join(_DIR, src_name)
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+def _compile(src_name, stem: str, extra_flags: tuple = ()) -> str:
+    """Build source file(s) into a content-hash-keyed shared library."""
+    names = [src_name] if isinstance(src_name, str) else list(src_name)
+    srcs = [os.path.join(_DIR, n) for n in names]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
     out = os.path.join(_DIR, f"lib{stem}-{digest}.so")
     if os.path.exists(out):
         return out
     tmp = out + ".tmp"
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        *extra_flags, "-o", tmp, src,
+        *extra_flags, "-o", tmp, *srcs,
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -79,6 +83,90 @@ def load_lhsha():
             lib.lhsha_has_shani.restype = ctypes.c_int
             _SHA_LIB = lib
     return _SHA_LIB or None
+
+
+_BLS_LIB = None
+
+
+def _bls_const_blob() -> bytes:
+    """Pack curve/tower constants for lhbls_init from the Python oracle's
+    RFC-anchored constants module — the C++ side transcribes nothing
+    (bls12381.cpp init contract)."""
+    from ..crypto.bls import constants as C
+    from ..crypto.bls.curve import _PSI_CX, _PSI_CY
+    from ..crypto.bls.fields import _FROB6_C1, _FROB6_C2, _FROB12_C1, Fq2
+
+    def fp_be(v: int) -> bytes:
+        return (v % C.P).to_bytes(48, "big")
+
+    def f2_be(t) -> bytes:
+        c0, c1 = (t.c0, t.c1) if isinstance(t, Fq2) else t
+        return fp_be(c0) + fp_be(c1)
+
+    from ..ops.htc import sswu_derived_constants
+
+    A, B, Z, c_exc, c_gen, sqrt_cands = sswu_derived_constants()
+
+    parts = [
+        C.P.to_bytes(48, "big"),  # the modulus itself — NOT reduced mod p
+        fp_be(C.G1_X), fp_be(C.G1_Y),
+        f2_be(C.G2_X), f2_be(C.G2_Y),
+        f2_be(_FROB6_C1), f2_be(_FROB6_C2), f2_be(_FROB12_C1),
+        f2_be(A), f2_be(B), f2_be(Z), f2_be(c_exc), f2_be(c_gen),
+    ]
+    for coeffs in (C.ISO3_X_NUM, C.ISO3_X_DEN, C.ISO3_Y_NUM, C.ISO3_Y_DEN):
+        parts += [f2_be(c) for c in coeffs]
+    parts += [f2_be(_PSI_CX), f2_be(_PSI_CY)]
+    parts += [f2_be(c) for c in sqrt_cands]
+    return b"".join(parts)
+
+
+def load_lhbls():
+    """Native CPU BLS12-381 (bls12381.cpp + sha256.cpp): RLC batch verify,
+    hash-to-G2, pairing — the measured CPU baseline (SURVEY §2.6 item 1).
+    Returns None when the toolchain is unavailable."""
+    global _BLS_LIB
+    with _LOCK:
+        if _BLS_LIB is None:
+            try:
+                lib = ctypes.CDLL(
+                    _compile(
+                        ["bls12381.cpp", "sha256.cpp"], "lhbls",
+                        ("-O3", "-pthread"),
+                    )
+                )
+            except (NativeBuildError, OSError):
+                _BLS_LIB = False
+                return None
+            lib.lhbls_init.restype = ctypes.c_int
+            lib.lhbls_init.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lhbls_hash_to_g2.restype = ctypes.c_int
+            lib.lhbls_hash_to_g2.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            lib.lhbls_verify_batch.restype = ctypes.c_int
+            lib.lhbls_verify_batch.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_uint64,
+            ]
+            lib.lhbls_pairing.restype = ctypes.c_int
+            lib.lhbls_pairing.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            from ..crypto.bls.constants import DST
+
+            blob = _bls_const_blob()
+            rc = lib.lhbls_init(blob, len(blob), DST, len(DST))
+            if rc != 0:
+                _BLS_LIB = False
+                return None
+            _BLS_LIB = lib
+    return _BLS_LIB or None
 
 
 def load_lhkv() -> ctypes.CDLL:

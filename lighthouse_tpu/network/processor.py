@@ -60,6 +60,10 @@ class WorkEvent:
     message_id: bytes | None = None
     seen_slot: int | None = None
     topic_kind: str | None = None  # originating gossip topic kind
+    # Set when the event is re-emitted by the ReprocessQueue: the router
+    # must not park it again (expired unknown-block attestations would
+    # otherwise cycle park -> expire -> re-park forever).
+    reprocessed: bool = False
 
 
 @dataclass

@@ -94,6 +94,12 @@ class Router:
         for ev, res in zip(events, results):
             if isinstance(res, Exception):
                 if str(res) in _UNKNOWN_BLOCK_ERRORS:
+                    if ev.reprocessed:
+                        # already waited a full delay window and the block
+                        # never came: reject (no second parking — that
+                        # would cycle forever for withheld blocks)
+                        self.stats["attestations_rejected"] += 1
+                        continue
                     # the block is probably milliseconds behind on gossip:
                     # park for reprocessing, no peer penalty
                     # (work_reprocessing_queue.rs)
@@ -124,6 +130,9 @@ class Router:
                 )
             except (AttestationError, ValueError) as e:
                 if str(e) in _UNKNOWN_BLOCK_ERRORS:
+                    if ev.reprocessed:
+                        self.stats["attestations_rejected"] += 1
+                        continue
                     self.reprocess.queue_unknown_block_attestation(
                         ev,
                         bytes(

@@ -1,0 +1,479 @@
+"""Real inter-process transport: TCP gossip/req-resp + UDP discovery.
+
+Round 1 modeled the reference's libp2p swarm
+(`lighthouse_network/src/service.rs:53-120`) with an in-process hub; this
+module is the socket-backed twin so two OS processes can actually peer.
+It presents the SAME surface as transport.Peer (subscribe / publish /
+register_rpc / request / deliver_pending), so NetworkService, Router and
+SyncManager run unchanged over either.
+
+Wire protocol (all frames: 4-byte big-endian length + 1-byte type):
+
+  HELLO      peer_id                      — sent by dialer and listener on
+                                            connect, then both sides send
+                                            their current SUB set
+  SUB/UNSUB  topic                        — gossip subscription control
+  GOSSIP     msg_id(20) topic_len(2) topic wire
+                                          — fan-out push, dedup by msg_id
+  REQ        req_id(8) proto_len(2) proto wire
+  RESP       req_id(8) chunk              — one per response chunk
+  END        req_id(8) status(1)          — 0 ok, 1 error
+
+Payloads are the production ssz_snappy bytes (pubsub/rpc codecs), exactly
+like the hub. Gossip deliveries land in a thread-safe inbox drained by
+``deliver_pending`` — the deterministic drive model the node loop already
+uses. Discovery is a UDP ENR-style registry (discovery.py semantics over
+datagrams): PING registers {peer_id, host, port}, FIND returns the known
+records. The reference's noise encryption/yamux muxing are not modeled
+(one TCP stream per direction; see PARITY.md gap note).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from .gossip import message_id
+from . import snappy
+
+_HELLO, _SUB, _UNSUB, _GOSSIP, _REQ, _RESP, _END = range(7)
+_MAX_FRAME = 1 << 26  # 64 MiB — a full minimal-preset state fits easily
+
+
+def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    sock.sendall(struct.pack(">IB", len(payload) + 1, ftype) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
+    (length,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if not 1 <= length <= _MAX_FRAME:
+        raise ConnectionError(f"bad frame length {length}")
+    body = _recv_exact(sock, length)
+    return body[0], body[1:]
+
+
+@dataclass
+class _Delivery:
+    topic: str
+    msg_id: bytes
+    wire: bytes
+    source: str
+
+
+class _Conn:
+    """One established peer link (either direction): writer + reader
+    thread feeding the owner's inbox."""
+
+    def __init__(self, owner: "SocketPeer", sock: socket.socket):
+        self.owner = owner
+        self.sock = sock
+        self.peer_id: str | None = None
+        self.remote_subs: set[str] = set()
+        self.alive = True
+        self.wlock = threading.Lock()
+        self._responses: dict[int, tuple[list, threading.Event, list]] = {}
+
+    def send(self, ftype: int, payload: bytes) -> None:
+        with self.wlock:
+            _send_frame(self.sock, ftype, payload)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ reading
+    def run_reader(self) -> None:
+        try:
+            while self.alive:
+                ftype, body = _recv_frame(self.sock)
+                self._handle(ftype, body)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.alive = False
+            self.owner._drop_conn(self)
+
+    def _handle(self, ftype: int, body: bytes) -> None:
+        o = self.owner
+        if ftype == _HELLO:
+            self.peer_id = body.decode()
+            o._register_conn(self)
+        elif ftype == _SUB:
+            self.remote_subs.add(body.decode())
+        elif ftype == _UNSUB:
+            self.remote_subs.discard(body.decode())
+        elif ftype == _GOSSIP:
+            msg_id = body[:20]
+            (tlen,) = struct.unpack(">H", body[20:22])
+            topic = body[22 : 22 + tlen].decode()
+            wire = body[22 + tlen :]
+            o._on_gossip_frame(topic, msg_id, wire, self.peer_id or "?")
+        elif ftype == _REQ:
+            (req_id,) = struct.unpack(">Q", body[:8])
+            (plen,) = struct.unpack(">H", body[8:10])
+            proto = body[10 : 10 + plen].decode()
+            wire = body[10 + plen :]
+            handler = o.rpc_handlers.get(proto)
+            try:
+                if handler is None:
+                    raise ConnectionError(f"unknown protocol {proto}")
+                chunks = handler(self.peer_id or "?", wire)
+                for c in chunks:
+                    self.send(_RESP, struct.pack(">Q", req_id) + c)
+                self.send(_END, struct.pack(">QB", req_id, 0))
+            except Exception:
+                try:
+                    self.send(_END, struct.pack(">QB", req_id, 1))
+                except (ConnectionError, OSError):
+                    pass
+        elif ftype == _RESP:
+            (req_id,) = struct.unpack(">Q", body[:8])
+            slot = self._responses.get(req_id)
+            if slot is not None:
+                slot[0].append(body[8:])
+        elif ftype == _END:
+            (req_id,) = struct.unpack(">Q", body[:8])
+            slot = self._responses.pop(req_id, None)
+            if slot is not None:
+                slot[2].append(body[8])
+                slot[1].set()
+
+    # ------------------------------------------------------------ request
+    def request(self, proto: str, wire: bytes, timeout: float):
+        req_id = self.owner._next_req_id()
+        chunks: list = []
+        done = threading.Event()
+        status: list = []
+        self._responses[req_id] = (chunks, done, status)
+        pb = proto.encode()
+        self.send(
+            _REQ,
+            struct.pack(">Q", req_id) + struct.pack(">H", len(pb)) + pb + wire,
+        )
+        if not done.wait(timeout):
+            self._responses.pop(req_id, None)
+            raise ConnectionError(f"request {proto} timed out")
+        if status and status[0] != 0:
+            raise ConnectionError(f"request {proto} failed remotely")
+        return chunks
+
+
+class SocketPeer:
+    """Socket-backed twin of transport.Peer."""
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.peer_id = peer_id
+        self.subscriptions: set[str] = set()
+        self.seen_ids: set[bytes] = set()
+        self.rpc_handlers: dict[str, Callable] = {}
+        self.on_gossip: Callable | None = None
+        self._inbox: deque[_Delivery] = deque()
+        self._lock = threading.Lock()
+        self._conns: dict[str, _Conn] = {}   # peer_id -> conn
+        self._pending: list[_Conn] = []
+        self._req_counter = 0
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.host, self.port = self._listener.getsockname()
+        self._alive = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values()) + list(self._pending)
+        for c in conns:
+            c.close()
+
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            self._start_conn(sock)
+
+    def _start_conn(self, sock: socket.socket) -> _Conn:
+        conn = _Conn(self, sock)
+        with self._lock:
+            self._pending.append(conn)
+        conn.send(_HELLO, self.peer_id.encode())
+        for topic in sorted(self.subscriptions):
+            conn.send(_SUB, topic.encode())
+        threading.Thread(target=conn.run_reader, daemon=True).start()
+        return conn
+
+    def _register_conn(self, conn: _Conn) -> None:
+        with self._lock:
+            if conn in self._pending:
+                self._pending.remove(conn)
+            old = self._conns.get(conn.peer_id)
+            self._conns[conn.peer_id] = conn
+        if old is not None and old is not conn:
+            old.close()
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        with self._lock:
+            if conn in self._pending:
+                self._pending.remove(conn)
+            if self._conns.get(conn.peer_id) is conn:
+                del self._conns[conn.peer_id]
+
+    def _next_req_id(self) -> int:
+        with self._lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    # ------------------------------------------------------------- dialing
+    def connect(self, host: str, port: int, timeout: float = 5.0) -> str:
+        """Dial a remote node; returns its peer id once HELLO completes."""
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(None)
+        conn = self._start_conn(sock)
+        deadline = time.monotonic() + timeout
+        while conn.peer_id is None:
+            if time.monotonic() > deadline or not conn.alive:
+                conn.close()
+                raise ConnectionError("HELLO timeout")
+            time.sleep(0.01)
+        return conn.peer_id
+
+    def connected_peers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._conns)
+
+    # -------------------------------------------------------------- gossip
+    def subscribe(self, topic: str) -> None:
+        topic = str(topic)
+        self.subscriptions.add(topic)
+        for c in self._all_conns():
+            try:
+                c.send(_SUB, topic.encode())
+            except (ConnectionError, OSError):
+                pass
+
+    def unsubscribe(self, topic: str) -> None:
+        topic = str(topic)
+        self.subscriptions.discard(topic)
+        for c in self._all_conns():
+            try:
+                c.send(_UNSUB, topic.encode())
+            except (ConnectionError, OSError):
+                pass
+
+    def _all_conns(self) -> list[_Conn]:
+        with self._lock:
+            return list(self._conns.values())
+
+    def publish(self, topic: str, wire: bytes) -> bytes:
+        topic = str(topic)
+        mid = message_id(snappy.decompress(wire))
+        self.seen_ids.add(mid)
+        frame = (
+            mid + struct.pack(">H", len(topic.encode()))
+            + topic.encode() + wire
+        )
+        for c in self._all_conns():
+            if topic in c.remote_subs:
+                try:
+                    c.send(_GOSSIP, frame)
+                except (ConnectionError, OSError):
+                    pass
+        return mid
+
+    def _on_gossip_frame(self, topic, msg_id, wire, source) -> None:
+        if topic not in self.subscriptions or msg_id in self.seen_ids:
+            return
+        self.seen_ids.add(msg_id)
+        with self._lock:
+            self._inbox.append(_Delivery(topic, msg_id, wire, source))
+        # gossipsub fan-out: forward to other subscribed peers
+        frame = (
+            msg_id + struct.pack(">H", len(topic.encode()))
+            + topic.encode() + wire
+        )
+        for c in self._all_conns():
+            if c.peer_id != source and topic in c.remote_subs:
+                try:
+                    c.send(_GOSSIP, frame)
+                except (ConnectionError, OSError):
+                    pass
+
+    # ----------------------------------------------------------------- rpc
+    def register_rpc(self, protocol: str, handler: Callable) -> None:
+        self.rpc_handlers[protocol] = handler
+
+    def request(self, target_peer: str, protocol: str, request_wire: bytes,
+                timeout: float = 10.0):
+        conn = self._conns.get(target_peer)
+        if conn is None or not conn.alive:
+            raise ConnectionError(f"not connected to {target_peer!r}")
+        return conn.request(protocol, request_wire, timeout)
+
+    # ------------------------------------------------------------ delivery
+    def deliver_pending(self) -> int:
+        n = 0
+        while True:
+            with self._lock:
+                if not self._inbox:
+                    return n
+                d = self._inbox.popleft()
+            if self.on_gossip is not None:
+                self.on_gossip(d.topic, d.msg_id, d.wire, d.source)
+            n += 1
+
+    def wait_for_messages(self, timeout: float = 1.0) -> int:
+        """Block until at least one delivery is pending (or timeout)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inbox:
+                    return len(self._inbox)
+            time.sleep(0.005)
+        return 0
+
+
+class SocketHub:
+    """Hub-shaped adapter so NetworkService runs unchanged over sockets:
+    ``join`` binds a listening SocketPeer (normally one per process).
+    Discovery's in-process ENR registry rides on this object exactly as
+    on InMemoryHub; cross-process discovery goes over UDP
+    (:func:`discover_and_connect`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self.peers: dict[str, SocketPeer] = {}
+
+    def join(self, peer_id: str) -> SocketPeer:
+        if peer_id in self.peers:
+            raise ValueError(f"duplicate peer id {peer_id!r}")
+        peer = SocketPeer(peer_id, self.host, self.port)
+        self.peers[peer_id] = peer
+        return peer
+
+    def leave(self, peer_id: str) -> None:
+        peer = self.peers.pop(peer_id, None)
+        if peer is not None:
+            peer.close()
+
+
+# ------------------------------------------------------------- discovery
+
+
+class UdpDiscoveryServer:
+    """ENR-registry-over-UDP (the boot node role): PING registers a
+    record, FIND answers with all known records. Datagram twin of
+    discovery.py's HTTP registry; capability analog of discv5's
+    bootstrap role (reference: boot_node/, discovery/mod.rs)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.records: dict[str, dict] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        self.host, self.port = self._sock.getsockname()
+        self._alive = True
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while self._alive:
+            try:
+                data, addr = self._sock.recvfrom(65535)
+            except OSError:
+                return
+            try:
+                msg = json.loads(data.decode())
+            except ValueError:
+                continue
+            if msg.get("op") == "ping" and "record" in msg:
+                rec = msg["record"]
+                if isinstance(rec, dict) and "peer_id" in rec:
+                    self.records[rec["peer_id"]] = rec
+                    self._sock.sendto(b'{"op":"pong"}', addr)
+            elif msg.get("op") == "find":
+                out = json.dumps(
+                    {"op": "nodes", "records": list(self.records.values())}
+                ).encode()
+                self._sock.sendto(out, addr)
+
+
+def udp_register(boot: tuple[str, int], record: dict,
+                 timeout: float = 2.0) -> bool:
+    """PING a boot node with our record; True when acked."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(json.dumps({"op": "ping", "record": record}).encode(), boot)
+        data, _ = sock.recvfrom(65535)
+        return json.loads(data.decode()).get("op") == "pong"
+    except (OSError, ValueError):
+        return False
+    finally:
+        sock.close()
+
+
+def udp_find(boot: tuple[str, int], timeout: float = 2.0) -> list[dict]:
+    """FIND: fetch all records the boot node knows."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(b'{"op":"find"}', boot)
+        data, _ = sock.recvfrom(1 << 20)
+        msg = json.loads(data.decode())
+        return msg.get("records", []) if msg.get("op") == "nodes" else []
+    except (OSError, ValueError):
+        return []
+    finally:
+        sock.close()
+
+
+def discover_and_connect(peer: SocketPeer, boot: tuple[str, int]) -> int:
+    """Register ourselves, then dial every other advertised node."""
+    udp_register(
+        boot,
+        {"peer_id": peer.peer_id, "host": peer.host, "port": peer.port},
+    )
+    n = 0
+    for rec in udp_find(boot):
+        if rec["peer_id"] == peer.peer_id:
+            continue
+        if rec["peer_id"] in peer.connected_peers():
+            continue
+        try:
+            peer.connect(rec["host"], int(rec["port"]))
+            n += 1
+        except (ConnectionError, OSError):
+            continue
+    return n

@@ -139,7 +139,10 @@ class AttestationSubnetService:
                 msgs += self._subscribe_short(subnet_id, sub.slot)
 
         for subnet_id, slot in sorted(to_discover.items()):
-            if slot + MIN_PEER_DISCOVERY_SLOT_LOOK_AHEAD >= current_slot:
+            # Only discover for duties far enough out that discovery can
+            # complete in time (attestation_subnets.rs:282) — imminent or
+            # past duties are suppressed.
+            if slot >= current_slot + MIN_PEER_DISCOVERY_SLOT_LOOK_AHEAD:
                 msgs.append(
                     SubnetMessage("discover_peers", "attestation", subnet_id,
                                   min_ttl_slot=slot)

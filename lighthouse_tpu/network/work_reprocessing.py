@@ -106,7 +106,16 @@ class ReprocessQueue:
 
     def tick(self, current_slot: int) -> int:
         """Expire overdue attestations; release early blocks whose slot
-        started. Returns events released back into the processor."""
+        started. Returns events released back into the processor.
+
+        Expired attestations are RE-QUEUED, not dropped: the reference's
+        DelayQueue expiry path emits them as ReadyWork so they still reach
+        the verification pipeline (which will fail them properly against
+        fork choice, feeding peer scoring) — silently losing them would
+        weaken aggregation and fork-choice inputs for blocks that arrive
+        via sync rather than gossip. The ``reprocessed`` flag stops the
+        router from parking them a second time (no park/expire cycle).
+        """
         released = 0
         for root in list(self._awaiting_block):
             keep = []
@@ -114,6 +123,9 @@ class ReprocessQueue:
                 if current_slot > p.expiry_slot:
                     self._parked_count -= 1
                     self.stats["expired"] += 1
+                    p.event.reprocessed = True
+                    self.processor.send(p.event)
+                    released += 1
                 else:
                     keep.append(p)
             if keep:

@@ -263,6 +263,19 @@ class ClientBuilder:
                 backend=cfg.backend,
             )
 
+        # HBM-resident pubkey table (blsrt): with the device backend on
+        # real hardware, mirror the pubkey cache into HBM so verify
+        # batches gather by validator index instead of re-uploading
+        # coordinates (SURVEY §7.1 layer 2; reference keeps this cache
+        # host-side, validator_pubkey_cache.rs:20-24).
+        if cfg.backend == "jax":
+            import jax as _jax
+
+            if _jax.default_backend() == "tpu":
+                from ..blsrt import DevicePubkeyTable
+
+                chain.pubkey_cache.attach_device_table(DevicePubkeyTable())
+
         network = None
         if self._hub is not None:
             network = NetworkService(

@@ -1,0 +1,335 @@
+"""Batched hash-to-G2 (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_) on device.
+
+The reference client hashes messages to G2 one at a time in blst C/asm
+(crypto/bls/src/impls/blst.rs:14 supplies the DST). Round-1 left this step
+as the pure-Python oracle at ~8.6 ms/message — the end-to-end bottleneck.
+The TPU-first answer is not a faster sequential hash but a *batched* one:
+a slot's worth of messages map to the curve simultaneously, every step
+branch-free over lanes:
+
+    host:   expand_message_xmd (SHA-256, C-speed hashlib) -> hash_to_field
+            -> u as Montgomery limb tensors          [n, 2(u0/u1), 2, 48]
+    device: simplified SWU onto E2'                  (one Fq2 sqrt per u)
+            3-isogeny E2' -> E2 (denominator-free Jacobian output)
+            Q0 + Q1, Budroni-Pintore cofactor clearing via the ψ
+            endomorphism, one batched affine normalization.
+
+Fq2 square roots use the q ≡ 9 (mod 16) candidate method (RFC 9380 §I.3):
+ONE exponentiation a^((q+7)/16) (a single lax.scan) then a 4-way select
+among root-of-unity multiples — uniform over every oracle edge case
+(c1 == 0, non-residues, zero), unlike the complex method's branching.
+
+Oracle counterpart: crypto/bls/hash_to_curve.py (hash_to_g2); parity is
+asserted per stage in tests/test_htc.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls.constants import (
+    DST,
+    H2F_L,
+    ISO3_X_DEN,
+    ISO3_X_NUM,
+    ISO3_Y_DEN,
+    ISO3_Y_NUM,
+    P,
+    SSWU_A2,
+    SSWU_B2,
+    SSWU_Z2,
+    X as X_PARAM,
+)
+from ..crypto.bls.hash_to_curve import expand_message_xmd
+from . import limb, tower
+from .points import (
+    FP2_OPS,
+    PSI_CX_DEV,
+    PSI_CY_DEV,
+    pt_add,
+    pt_double,
+    pt_neg,
+    pt_scalar_mul_const,
+    pt_to_affine,
+)
+from .tower import fp2_add, fp2_mul, fp2_sqr
+
+# ------------------------------------------------------------- constants
+
+_Q = P * P  # |Fq2|; q % 16 == 9
+
+
+from ..crypto.bls.fields import Fq2 as _Fq2  # noqa: E402
+
+
+def sswu_derived_constants():
+    """SSWU derived constants as oracle Fq2 values, shared with the native
+    C++ backend's init blob (native/__init__.py _bls_const_blob):
+    (A, B, Z, C_EXC = B/(Z*A), C_GEN = -B/A, sqrt candidates [1, u,
+    sqrt(u), sqrt(-u)])."""
+    A, B, Z = _Fq2(*SSWU_A2), _Fq2(*SSWU_B2), _Fq2(*SSWU_Z2)
+    c_exc = B * (Z * A).inv()
+    c_gen = (-B) * A.inv()
+    root_u = _Fq2(0, 1).sqrt()
+    root_nu = _Fq2(0, P - 1).sqrt()
+    assert root_u is not None and root_nu is not None
+    return A, B, Z, c_exc, c_gen, (_Fq2(1, 0), _Fq2(0, 1), root_u, root_nu)
+
+
+_A, _B, _Z, _C_EXC, _C_GEN, _SQRT_CANDS = sswu_derived_constants()
+
+# sqrt_ratio machinery (q = p^2 ≡ 9 mod 16). For t = u*v^7*(u*v^15)^E with
+# E = (q-9)/16: t^2*v/u is a 4th root of unity when u/v is square, so one
+# of t*{1, w, sqrt(w), sqrt(-w)} (w = sqrt(-1)) is sqrt(u/v); when u/v is
+# NOT square, Z*u/v IS (Z is a non-residue), and sqrt(Z*u/v) = C_Z*t*cand
+# with the constant C_Z = Z^(1+E). This is the RFC 9380 F.2.1 contract
+# ((True, sqrt(u/v)) | (False, sqrt(Z*u/v))) with ONE exponentiation.
+_SQRT_RATIO_E = (_Q - 9) // 16
+_C_Z = _Z.pow(1 + _SQRT_RATIO_E)
+SQRT_RATIO_BITS = np.asarray(
+    [int(b) for b in bin(_SQRT_RATIO_E)[2:]], np.int32
+)
+
+A_DEV = jnp.asarray(tower.fq2_to_dev(_A))
+B_DEV = jnp.asarray(tower.fq2_to_dev(_B))
+Z_DEV = jnp.asarray(tower.fq2_to_dev(_Z))
+C_Z_DEV = jnp.asarray(tower.fq2_to_dev(_C_Z))
+SQRT_CANDS_DEV = jnp.stack(
+    [jnp.asarray(tower.fq2_to_dev(c)) for c in _SQRT_CANDS]
+)  # [4, 2, 48]
+
+def _f2c(c) -> jnp.ndarray:
+    return jnp.asarray(tower.fp2_to_dev(c[0] % P, c[1] % P))
+
+
+_ISO_XNUM = jnp.stack([_f2c(c) for c in ISO3_X_NUM])
+_ISO_XDEN = jnp.stack([_f2c(c) for c in ISO3_X_DEN])
+_ISO_YNUM = jnp.stack([_f2c(c) for c in ISO3_Y_NUM])
+_ISO_YDEN = jnp.stack([_f2c(c) for c in ISO3_Y_DEN])
+
+# Budroni-Pintore scalars (X_PARAM < 0): both positive after expansion.
+_K_X2 = X_PARAM * X_PARAM - X_PARAM - 1  # x^2 - x - 1 > 0
+_K_X1 = X_PARAM - 1                      # negative; handled by mul_const
+
+
+# ------------------------------------------------------------ field bits
+
+
+def fp2_pow_const(a, e_bits: np.ndarray):
+    """a^e for a compile-time exponent bit string (MSB first), batched.
+
+    One lax.scan whose body is fp2_sqr + masked fp2_mul — the Fq2 twin of
+    limb.mont_pow_const.
+    """
+    bits = jnp.asarray(e_bits, jnp.int32)
+
+    def step(acc, bit):
+        acc = fp2_sqr(acc)
+        acc = jnp.where((bit == 1)[(...,) + (None,) * 2], fp2_mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = lax.scan(step, a, bits[1:])  # leading bit consumes a
+    return acc
+
+
+def sqrt_ratio(u, v):
+    """Batched RFC 9380 F.2.1 sqrt_ratio: (is_square, y) with
+    y = sqrt(u/v) when u/v is a QR, else y = sqrt(Z*u/v). Division-free,
+    ONE exponentiation (see SQRT_RATIO_BITS derivation above)."""
+    v2 = fp2_sqr(v)
+    v4 = fp2_sqr(v2)
+    v7 = fp2_mul(fp2_mul(v4, v2), v)
+    uv7 = fp2_mul(u, v7)
+    uv15 = fp2_mul(uv7, fp2_mul(v4, v4))
+    t = fp2_mul(uv7, fp2_pow_const(uv15, SQRT_RATIO_BITS))
+
+    root = jnp.broadcast_to(tower.FP2_ZERO, t.shape)
+    ok = jnp.zeros(t.shape[:-2], bool)
+    zu = fp2_mul(jnp.broadcast_to(Z_DEV, u.shape), u)
+    tz = fp2_mul(t, C_Z_DEV)
+    for i in range(4):
+        cand = fp2_mul(t, SQRT_CANDS_DEV[i])
+        hit = tower.fp2_eq(fp2_mul(fp2_sqr(cand), v), u) & ~ok
+        root = FP2_OPS.select(hit, cand, root)
+        ok = ok | hit
+    is_sq = ok
+    found_z = jnp.zeros(t.shape[:-2], bool)
+    for i in range(4):
+        cand = fp2_mul(tz, SQRT_CANDS_DEV[i])
+        hit = tower.fp2_eq(fp2_mul(fp2_sqr(cand), v), zu) & ~is_sq & ~found_z
+        root = FP2_OPS.select(hit, cand, root)
+        found_z = found_z | hit
+    return is_sq, root
+
+
+def fp2_sgn0(a):
+    """RFC 9380 sgn0 for Fp2 (m = 2) on Montgomery-form limbs."""
+    c0 = limb.from_mont(a[..., 0, :])
+    c1 = limb.from_mont(a[..., 1, :])
+    sign0 = c0[..., 0] & 1
+    zero0 = jnp.all(c0 == 0, axis=-1)
+    sign1 = c1[..., 0] & 1
+    return sign0 | (zero0.astype(jnp.int32) & sign1)
+
+
+# ------------------------------------------------------------------ SSWU
+
+
+def sswu_fq2(u):
+    """Simplified SWU onto E2' (RFC 9380 §6.6.2), batched and
+    division-free: u[..., 2, 48] -> (x_num, x_den, y) with affine
+    x = x_num/x_den on y^2 = x^3 + A'x + B'. The fraction feeds straight
+    into the isogeny's rational maps, so no inversion ever happens.
+
+    Derivation: x1 = (-B/A)(1 + 1/(Z^2u^4 + Zu^2)) = num1/den with
+    num1 = B(tv2+1), den = -A*tv2 (tv2 = Z^2u^4 + Zu^2), and the
+    exceptional tv2 == 0 lane gets x1 = B/(Z*A). gx1 = gxn/gxd with
+    gxn = num1^3 + A*num1*den^2 + B*den^3, gxd = den^3. sqrt_ratio
+    gives sqrt(gx1) or sqrt(Z*gx1); in the non-square case
+    x2 = Z*u^2*x1 and y2 = Z*u^2*u*y1 (gx2 = (Zu^2)^3 * gx1)."""
+    shape = u.shape
+    tv1 = fp2_mul(jnp.broadcast_to(Z_DEV, shape), fp2_sqr(u))  # Z u^2
+    tv2 = fp2_add(fp2_sqr(tv1), tv1)
+    exc = tower.fp2_is_zero(tv2)
+    one = jnp.broadcast_to(tower.FP2_ONE, shape)
+    a = jnp.broadcast_to(A_DEV, shape)
+    b = jnp.broadcast_to(B_DEV, shape)
+    num1 = fp2_mul(b, fp2_add(tv2, one))
+    den = FP2_OPS.select(
+        exc,
+        fp2_mul(jnp.broadcast_to(Z_DEV, shape), a),
+        tower.fp2_neg(fp2_mul(a, tv2)),
+    )
+    den2 = fp2_sqr(den)
+    gxn = fp2_add(
+        fp2_add(
+            fp2_mul(fp2_sqr(num1), num1),
+            fp2_mul(fp2_mul(a, num1), den2),
+        ),
+        fp2_mul(b, fp2_mul(den2, den)),
+    )
+    gxd = fp2_mul(den2, den)
+    is_sq, y1 = sqrt_ratio(gxn, gxd)
+
+    x_num = FP2_OPS.select(is_sq, num1, fp2_mul(tv1, num1))
+    y = FP2_OPS.select(is_sq, y1, fp2_mul(fp2_mul(tv1, u), y1))
+    flip = fp2_sgn0(u) != fp2_sgn0(y)
+    y = FP2_OPS.select(flip, tower.fp2_neg(y), y)
+    return x_num, den, y
+
+
+def _poly_frac(coeffs, npows, dpows, deg: int):
+    """Evaluate a degree-`deg` polynomial at the fraction n/d, scaled by
+    d^deg: sum_i c_i * n^i * d^(deg-i). npows/dpows are power tables."""
+    acc = None
+    for i in range(deg + 1):
+        term = fp2_mul(
+            jnp.broadcast_to(coeffs[i], npows[1].shape),
+            fp2_mul(npows[i], dpows[deg - i]),
+        )
+        acc = term if acc is None else fp2_add(acc, term)
+    return acc
+
+
+def iso3_jacobian(xn_in, xd_in, y):
+    """3-isogeny E2' -> E2 on a fractional x = xn_in/xd_in, no inversions.
+
+    Each rational map scaled by xd_in^deg becomes a polynomial in
+    (xn_in, xd_in); the d^3 factors cancel in y_num/y_den, leaving
+    x_iso = Xn/(d*Xd) and y_iso = y*Yn/Yd — packed into Jacobian
+    coordinates with Z = (d*Xd)*Yd (zero denominators -> infinity,
+    the oracle's exceptional-case rule)."""
+    shape = xn_in.shape
+    one = jnp.broadcast_to(tower.FP2_ONE, shape)
+    npows = [one, xn_in, fp2_sqr(xn_in)]
+    npows.append(fp2_mul(npows[2], xn_in))
+    dpows = [one, xd_in, fp2_sqr(xd_in)]
+    dpows.append(fp2_mul(dpows[2], xd_in))
+
+    Xn = _poly_frac(_ISO_XNUM, npows, dpows, 3)
+    Xd = _poly_frac(_ISO_XDEN, npows, dpows, 2)
+    Yn = _poly_frac(_ISO_YNUM, npows, dpows, 3)
+    Yd = _poly_frac(_ISO_YDEN, npows, dpows, 3)
+
+    xd2 = fp2_mul(xd_in, Xd)
+    Z = fp2_mul(xd2, Yd)
+    X = fp2_mul(Xn, fp2_mul(xd2, fp2_sqr(Yd)))
+    Y = fp2_mul(
+        fp2_mul(y, Yn), fp2_mul(fp2_mul(xd2, fp2_sqr(xd2)), fp2_sqr(Yd))
+    )
+    return (X, Y, Z)
+
+
+# -------------------------------------------------------------- cofactor
+
+
+def psi_jacobian(Q):
+    """ψ on Jacobian coordinates: conj is an Fp2 automorphism, so applying
+    it coordinate-wise and scaling by the affine twist constants commutes
+    with x = X/Z^2, y = Y/Z^3 (curve.py psi())."""
+    X, Y, Z = Q
+    return (
+        fp2_mul(tower.fp2_conj(X), PSI_CX_DEV),
+        fp2_mul(tower.fp2_conj(Y), PSI_CY_DEV),
+        tower.fp2_conj(Z),
+    )
+
+
+def clear_cofactor(Q):
+    """h_eff * Q via Budroni-Pintore (curve.py clear_cofactor_g2):
+    (x^2-x-1) Q + (x-1) ψ(Q) + ψ(ψ(2Q))."""
+    t0 = pt_scalar_mul_const(FP2_OPS, Q, _K_X2)
+    t1m = pt_scalar_mul_const(FP2_OPS, pt_neg(FP2_OPS, Q), -_K_X1)
+    t1 = psi_jacobian(t1m)
+    t2 = psi_jacobian(psi_jacobian(pt_double(FP2_OPS, Q)))
+    return pt_add(FP2_OPS, pt_add(FP2_OPS, t0, t1), t2)
+
+
+# ---------------------------------------------------------------- driver
+
+
+def map_to_g2(u):
+    """Device pipeline: u[n, 2, 2, 48] (two Fq2 per message, Montgomery)
+    -> affine (x, y, inf) G2 batch. Jit once via map_to_g2_jit."""
+    xn0, xd0, y0 = sswu_fq2(u[:, 0])
+    xn1, xd1, y1 = sswu_fq2(u[:, 1])
+    Q = pt_add(
+        FP2_OPS, iso3_jacobian(xn0, xd0, y0), iso3_jacobian(xn1, xd1, y1)
+    )
+    Q = clear_cofactor(Q)
+    return pt_to_affine(FP2_OPS, Q)
+
+
+map_to_g2_jit = jax.jit(map_to_g2)
+
+
+# ------------------------------------------------------------- host side
+
+
+def hash_to_field_dev(msgs, dst: bytes = DST) -> np.ndarray:
+    """Host: messages -> u tensor [n, 2, 2, 48] (Montgomery limb form).
+
+    expand_message_xmd runs at C speed (hashlib); the 64-byte-to-field
+    reduction uses Python bignums (sub-µs each). This is the only
+    per-message host work left in the hashing path.
+    """
+    out = np.empty((len(msgs), 2, 2, 48), np.int32)
+    for i, msg in enumerate(msgs):
+        uniform = expand_message_xmd(msg, dst, 4 * H2F_L)
+        for j in range(2):
+            for k in range(2):
+                off = H2F_L * (k + j * 2)
+                v = int.from_bytes(uniform[off : off + H2F_L], "big") % P
+                out[i, j, k] = tower.fp_to_dev(v)  # standard -> Montgomery
+    return out
+
+
+def hash_to_g2_batch(msgs, dst: bytes = DST):
+    """Full batched hash_to_curve: list of messages -> device affine batch
+    (x[n,2,48], y[n,2,48], inf[n]). Bit-exact with the oracle hash_to_g2
+    (tests/test_htc.py)."""
+    u = jnp.asarray(hash_to_field_dev(msgs, dst))
+    return map_to_g2_jit(u)

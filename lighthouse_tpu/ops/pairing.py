@@ -186,6 +186,18 @@ def final_exponentiation(f):
     return fp12_mul(fp12_mul(c, fp12_sqr(f)), f)
 
 
+def fp12_fold_scan(f_all, n: int):
+    """Scan-fold of n gathered Fp12 partials (one fp12_mul body)."""
+    if n == 1:
+        return f_all[0]
+
+    def step(acc, g):
+        return fp12_mul(acc, g), None
+
+    acc, _ = lax.scan(step, f_all[0], f_all[1:n])
+    return acc
+
+
 def fp12_tree_prod(f, axis_size: int):
     """Product over the leading axis by binary halving (pad with one)."""
     n = axis_size

@@ -244,7 +244,64 @@ def pt_subgroup_check(F, P):
     return pt_is_infinity(F, pt_scalar_mul_const(F, P, CURVE_ORDER))
 
 
+def pt_subgroup_check_g2_fast(x, y, inf):
+    """G2 membership via Bowe's ψ-criterion: psi(Q) == [x_bls]Q.
+
+    Classic-XLA twin of the Pallas ``subgroup_check_g2_fast_t`` kernel
+    (ops/tkernel_calls.py): a ~64-step scalar chain over the BLS parameter
+    plus one endomorphism evaluation, versus the 255-step full-order
+    multiply of :func:`pt_subgroup_check` — the compile-surface and runtime
+    win that keeps the sharded verifier's graph compact. Input is affine
+    (x, y, inf); Q must be on-curve (guaranteed by deserialization).
+    Infinity passes (pt_subgroup_check semantics).
+    """
+    from ..crypto.bls.constants import X as _X_PARAM
+
+    F = FP2_OPS
+    xbits = jnp.asarray([int(b) for b in bin(-_X_PARAM)[2:]], jnp.int32)
+
+    P0 = pt_from_affine(F, x, y, inf)
+
+    def step(acc, bit):
+        acc = pt_double(F, acc)
+        cand = pt_add_mixed(F, acc, (x, y), inf)
+        acc = tuple(F.select(bit == 1, c, a) for c, a in zip(cand, acc))
+        return acc, None
+
+    # Leading bit consumes P0 itself; x_bls < 0 so [x]Q = -[|x|]Q.
+    acc, _ = lax.scan(step, P0, xbits[1:])
+    Xj, Yj, Zj = acc[0], F.neg(acc[1]), acc[2]
+
+    # psi(Q) = (conj(x) * CX, conj(y) * CY), affine (curve.py psi()).
+    px = tower.fp2_mul(tower.fp2_conj(x), PSI_CX_DEV)
+    py = tower.fp2_mul(tower.fp2_conj(y), PSI_CY_DEV)
+
+    # Affine-vs-Jacobian equality without inversion: px == Xj/Zj^2 etc.
+    z2 = F.sqr(Zj)
+    z3 = F.mul(z2, Zj)
+    ok = F.eq(F.mul(px, z2), Xj) & F.eq(F.mul(py, z3), Yj)
+    # [x]Q infinite while Q isn't -> not in G2 (psi(Q) is finite).
+    ok = ok & ~F.is_zero(Zj)
+    return ok | inf
+
+
 # -------------------------------------------------------------- reductions
+
+
+def pt_fold_scan(F, parts, n: int):
+    """Fold n gathered partial-sum points (leading axis n) with a scan:
+    ONE pt_add body in the graph regardless of n (mesh-axis folds; the
+    sequential depth is a mesh dimension, i.e. tiny)."""
+    if n == 1:
+        return tuple(c[0] for c in parts)
+    init = tuple(c[0] for c in parts)
+    rest = tuple(c[1:n] for c in parts)
+
+    def step(acc, q):
+        return pt_add(F, acc, q), None
+
+    acc, _ = lax.scan(step, init, rest)
+    return acc
 
 
 def pt_tree_sum(F, P, axis_size: int):
@@ -289,18 +346,38 @@ def pt_tree_sum_axis(F, P, axis: int, axis_size: int):
 # ------------------------------------------------------- host conversions
 
 
+def _mont_batch(ints) -> np.ndarray:
+    """Host ints (standard domain) -> Montgomery limb batch [n, 48]."""
+    from ..crypto.bls.constants import P as _P
+
+    R = limb.R_MONT
+    return limb.ints_to_limbs([(v * R) % _P for v in ints])
+
+
 def g1_to_dev(points) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Oracle G1 AffinePoints -> (x, y, inf_mask) numpy batch (Montgomery)."""
-    xs = np.stack([tower.fp_to_dev(p.x.n) for p in points])
-    ys = np.stack([tower.fp_to_dev(p.y.n) for p in points])
+    """Oracle G1 AffinePoints -> (x, y, inf_mask) numpy batch (Montgomery).
+
+    Batched through one ints_to_limbs buffer per coordinate — the
+    per-point fp_to_dev/np.stack path this replaces dominated host-side
+    batch assembly at S=2048."""
+    xs = _mont_batch([p.x.n for p in points])
+    ys = _mont_batch([p.y.n for p in points])
     inf = np.asarray([p.infinity for p in points])
     return xs, ys, inf
 
 
 def g2_to_dev(points) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Oracle G2 AffinePoints -> (x, y, inf_mask) with Fp2 coords."""
-    xs = np.stack([np.asarray(tower.fp2_to_dev(p.x.c0, p.x.c1)) for p in points])
-    ys = np.stack([np.asarray(tower.fp2_to_dev(p.y.c0, p.y.c1)) for p in points])
+    n = len(points)
+    flat = []
+    for p in points:
+        flat.append(p.x.c0)
+        flat.append(p.x.c1)
+        flat.append(p.y.c0)
+        flat.append(p.y.c1)
+    limbs = _mont_batch(flat).reshape(n, 4, 48)
+    xs = np.ascontiguousarray(limbs[:, 0:2])
+    ys = np.ascontiguousarray(limbs[:, 2:4])
     inf = np.asarray([p.infinity for p in points])
     return xs, ys, inf
 
@@ -344,6 +421,13 @@ def g2_from_dev(x, y, inf):
                 )
             )
     return out
+
+
+# ψ-endomorphism twist constants (device, Montgomery form).
+from ..crypto.bls.curve import _PSI_CX, _PSI_CY  # noqa: E402
+
+PSI_CX_DEV = jnp.asarray(tower.fq2_to_dev(_PSI_CX))
+PSI_CY_DEV = jnp.asarray(tower.fq2_to_dev(_PSI_CY))
 
 
 # Generators as device constants (affine, Montgomery form).

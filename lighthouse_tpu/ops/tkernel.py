@@ -61,8 +61,22 @@ _IDX = {
     "FROB12_C1": 8,    # rows 8-9
     "PSI_CX": 10,      # rows 10-11: psi endomorphism x-coefficient
     "PSI_CY": 12,      # rows 12-13: psi endomorphism y-coefficient
+    # hash-to-curve rows (ops/tkernel_htc.py): SSWU parameters, the
+    # sqrt_ratio constant C_Z = Z^(1+(q-9)/16), the four 4th-root sqrt
+    # candidates, the 3-isogeny coefficient tables, and a standard-domain
+    # one (from-Montgomery multiplier for sgn0).
+    "ONE_STD": 14,
+    "SSWU_A": 15,      # rows 15-16
+    "SSWU_B": 17,      # rows 17-18
+    "SSWU_Z": 19,      # rows 19-20
+    "C_Z": 21,         # rows 21-22
+    "SQRT_CANDS": 23,  # rows 23-30 (4 x Fp2)
+    "ISO_XNUM": 31,    # rows 31-38 (4 x Fp2)
+    "ISO_XDEN": 39,    # rows 39-44 (3 x Fp2)
+    "ISO_YNUM": 45,    # rows 45-52 (4 x Fp2)
+    "ISO_YDEN": 53,    # rows 53-60 (4 x Fp2)
 }
-N_CONSTS = 14
+N_CONSTS = 61
 
 # Untwist-Frobenius-twist endomorphism coefficients for E'(Fp2):
 # psi(x, y) = (conj(x)*PSI_CX, conj(y)*PSI_CY), with psi(Q) = [x_bls]Q on
@@ -93,6 +107,32 @@ def _build_consts() -> np.ndarray:
         pair = tower.fq2_to_dev(fq2)  # Montgomery form
         c[_IDX[name], :, 0] = pair[0]
         c[_IDX[name] + 1, :, 0] = pair[1]
+
+    put("ONE_STD", _limb.int_to_limbs(1))
+    from . import htc as _htc
+
+    def put2(name, fq2, offset=0):
+        pair = tower.fq2_to_dev(fq2)
+        c[_IDX[name] + 2 * offset, :, 0] = pair[0]
+        c[_IDX[name] + 2 * offset + 1, :, 0] = pair[1]
+
+    put2("SSWU_A", _htc._A)
+    put2("SSWU_B", _htc._B)
+    put2("SSWU_Z", _htc._Z)
+    put2("C_Z", _htc._C_Z)
+    for i, cand in enumerate(_htc._SQRT_CANDS):
+        put2("SQRT_CANDS", cand, i)
+    from ..crypto.bls.constants import (
+        ISO3_X_DEN, ISO3_X_NUM, ISO3_Y_DEN, ISO3_Y_NUM,
+    )
+    from ..crypto.bls.fields import Fq2 as _Fq2
+
+    for name, coeffs in (
+        ("ISO_XNUM", ISO3_X_NUM), ("ISO_XDEN", ISO3_X_DEN),
+        ("ISO_YNUM", ISO3_Y_NUM), ("ISO_YDEN", ISO3_Y_DEN),
+    ):
+        for i, t in enumerate(coeffs):
+            put2(name, _Fq2(*t), i)
     return c
 
 

@@ -309,14 +309,13 @@ def to_affine_g2_t(P):
 
 
 def _miller_kernel(xp_ref, yp_ref, pinf_ref, xq_ref, yq_ref, qinf_ref,
-                   mbits_ref, consts_ref, out_ref):
+                   consts_ref, out_ref):
     with tk.bound_consts(consts_ref[:], lowmem=True):
         f = tp.miller_loop_t(
             (xp_ref[:], yp_ref[:]),
             pinf_ref[0, :] != 0,
             (xq_ref[:], yq_ref[:]),
             qinf_ref[0, :] != 0,
-            mbits_ref,
         )
         out_ref[:] = f
 
@@ -336,7 +335,7 @@ def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
     in_specs = _specs(
         [((N_LIMBS,), True), ((N_LIMBS,), True), ((1,), True),
          ((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
-         ((tp.MILLER_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -346,8 +345,7 @@ def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((2, 3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
-    )(xp, yp, pinf, xq, yq, qinf, _col(tp.MILLER_BITS_NP),
-      jnp.asarray(tk.CONSTS_NP))
+    )(xp, yp, pinf, xq, yq, qinf, jnp.asarray(tk.CONSTS_NP))
     return out[..., :t]
 
 

@@ -1,0 +1,305 @@
+"""Fused Pallas kernels for batched hash-to-G2 — the production TPU path.
+
+Mirror of ops/htc.py (division-free SSWU + 3-isogeny + Budroni-Pintore
+cofactor clearing) on the transposed layout, following the
+pairing.py/tkernel_pairing.py twin-module precedent. Two kernels carry the
+sequential depth:
+
+  * sswu+iso kernel — one ~757-step sqrt_ratio exponentiation chain per
+    lane plus straight-line SSWU/isogeny glue; emits Jacobian points on E2.
+  * cofactor kernel — the (x^2-x-1)Q chain (126 steps) and the (x-1)ψ(Q)
+    chain (64 steps) plus ψ²(2Q), fused into one program.
+
+The Q0+Q1 point addition between them is one XLA-level pt_add (log-depth
+glue, like the verifier's aggregation trees), and the final affine
+normalization reuses tkernel_calls.to_affine_g2_t.
+
+Parity: tests/test_htc.py compares every stage and the full pipeline
+against ops/htc.py (itself RFC 9380 J.10.1-anchored).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..crypto.bls.constants import X as X_PARAM
+from . import tkernel as tk
+from . import tkernel_calls as tc
+from .htc import SQRT_RATIO_BITS, _K_X2
+from .points import pt_add, pt_double, pt_neg
+from .tkernel import N_LIMBS
+from .tkernel_calls import _col, _pad_lanes, _specs, _tile_for
+
+SQRT_RATIO_NBITS = len(SQRT_RATIO_BITS)
+K_X2_BITS_NP = tk.bits_msb_first(_K_X2)
+K_X2_NBITS = len(K_X2_BITS_NP)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------ field bits
+
+
+def _cpair(name: str, off: int = 0):
+    """Fp2 constant rows [2, 48, 1] from the bound bundle (off selects
+    within multi-element tables like SQRT_CANDS/ISO_*)."""
+    i = tk._IDX[name] + 2 * off
+    return tk._bundle()[i:i + 2]
+
+
+def _fp2_pow_bits_t(a, bit_src, nbits: int):
+    """a^e in Fq2 by square-and-multiply over a bit-table ref (MSB first,
+    leading bit consumes a) — tk.pow_bits_t lifted to Fp2."""
+
+    def body(i, acc):
+        acc = tk.fp2_sqr_t(acc)
+        return jnp.where(bit_src[i, 0] == 1, tk.fp2_mul_t(acc, a), acc)
+
+    return jax.lax.fori_loop(1, nbits, body, a)
+
+
+def _fp2_sgn0_t(a):
+    """RFC 9380 sgn0 on transposed Montgomery Fp2 -> int32 [T]."""
+    c0 = tk.canonical_t(tk.mont_mul_t(a[..., 0, :, :], tk._c("ONE_STD")))
+    c1 = tk.canonical_t(tk.mont_mul_t(a[..., 1, :, :], tk._c("ONE_STD")))
+    sign0 = c0[..., 0, :] & 1
+    zero0 = jnp.all(c0 == 0, axis=-2).astype(jnp.int32)
+    sign1 = c1[..., 0, :] & 1
+    return sign0 | (zero0 & sign1)
+
+
+def _sqrt_ratio_t(u, v, ebits_ref):
+    """(is_square int32 [T], root) — htc.sqrt_ratio on the transposed
+    layout; ONE exponentiation + 8 candidate checks."""
+    F2 = tk.fp2_ops_t()
+    v2 = tk.fp2_sqr_t(v)
+    v4 = tk.fp2_sqr_t(v2)
+    uv7 = tk.fp2_mul_t(u, tk.fp2_mul_t(tk.fp2_mul_t(v4, v2), v))
+    uv15 = tk.fp2_mul_t(uv7, tk.fp2_mul_t(v4, v4))
+    t = tk.fp2_mul_t(uv7, _fp2_pow_bits_t(uv15, ebits_ref, SQRT_RATIO_NBITS))
+
+    zu = tk.fp2_mul_t(jnp.broadcast_to(_cpair("SSWU_Z"), u.shape), u)
+    tz = tk.fp2_mul_t(t, jnp.broadcast_to(_cpair("C_Z"), t.shape))
+    root = jnp.zeros_like(t)
+    ok = jnp.zeros(t.shape[-1:], jnp.int32)
+    for i in range(4):
+        cand = tk.fp2_mul_t(t, jnp.broadcast_to(_cpair("SQRT_CANDS", i), t.shape))
+        hit = (
+            tk.fp2_eq_t(tk.fp2_mul_t(tk.fp2_sqr_t(cand), v), u).astype(jnp.int32)
+            & (1 - ok)
+        )
+        root = jnp.where(hit == 1, cand, root)
+        ok = ok | hit
+    is_sq = ok
+    for i in range(4):
+        cand = tk.fp2_mul_t(tz, jnp.broadcast_to(_cpair("SQRT_CANDS", i), t.shape))
+        hit = (
+            tk.fp2_eq_t(tk.fp2_mul_t(tk.fp2_sqr_t(cand), v), zu).astype(jnp.int32)
+            & (1 - ok)
+        )
+        root = jnp.where(hit == 1, cand, root)
+        ok = ok | hit
+    del F2
+    return is_sq, root
+
+
+# --------------------------------------------------------- sswu + isogeny
+
+
+def _sswu_iso_kernel(u_ref, ebits_ref, consts_ref, out_ref):
+    with tk.bound_consts(consts_ref[:]):
+        u = u_ref[:]
+        shape = u.shape
+
+        def c2(name, off=0):
+            return jnp.broadcast_to(_cpair(name, off), shape)
+
+        a = c2("SSWU_A")
+        b = c2("SSWU_B")
+        z = c2("SSWU_Z")
+        one = jnp.broadcast_to(
+            jnp.stack([tk._c("R"), tk._c("ZERO")]), shape
+        )
+
+        tv1 = tk.fp2_mul_t(z, tk.fp2_sqr_t(u))          # Z u^2
+        tv2 = tk.add_t(tk.fp2_sqr_t(tv1), tv1)
+        exc = tk.fp2_is_zero_t(tv2)
+        num1 = tk.fp2_mul_t(b, tk.add_t(tv2, one))
+        den = jnp.where(
+            exc,
+            tk.fp2_mul_t(z, a),
+            tk.fp2_neg_t(tk.fp2_mul_t(a, tv2)),
+        )
+        den2 = tk.fp2_sqr_t(den)
+        gxn = tk.add_t(
+            tk.add_t(
+                tk.fp2_mul_t(tk.fp2_sqr_t(num1), num1),
+                tk.fp2_mul_t(tk.fp2_mul_t(a, num1), den2),
+            ),
+            tk.fp2_mul_t(b, tk.fp2_mul_t(den2, den)),
+        )
+        gxd = tk.fp2_mul_t(den2, den)
+        is_sq, y1 = _sqrt_ratio_t(gxn, gxd, ebits_ref)
+
+        sq = is_sq == 1
+        xn = jnp.where(sq, num1, tk.fp2_mul_t(tv1, num1))
+        y = jnp.where(sq, y1, tk.fp2_mul_t(tk.fp2_mul_t(tv1, u), y1))
+        flip = _fp2_sgn0_t(u) != _fp2_sgn0_t(y)
+        y = jnp.where(flip, tk.fp2_neg_t(y), y)
+
+        # 3-isogeny on the fraction xn/den (htc.iso3_jacobian).
+        npows = [one, xn, tk.fp2_sqr_t(xn)]
+        npows.append(tk.fp2_mul_t(npows[2], xn))
+        dpows = [one, den, tk.fp2_sqr_t(den)]
+        dpows.append(tk.fp2_mul_t(dpows[2], den))
+
+        def poly(name, deg):
+            acc = None
+            for i in range(deg + 1):
+                term = tk.fp2_mul_t(
+                    c2(name, i), tk.fp2_mul_t(npows[i], dpows[deg - i])
+                )
+                acc = term if acc is None else tk.add_t(acc, term)
+            return acc
+
+        Xn = poly("ISO_XNUM", 3)
+        Xd = poly("ISO_XDEN", 2)
+        Yn = poly("ISO_YNUM", 3)
+        Yd = poly("ISO_YDEN", 3)
+
+        xd2 = tk.fp2_mul_t(den, Xd)
+        Z = tk.fp2_mul_t(xd2, Yd)
+        X = tk.fp2_mul_t(Xn, tk.fp2_mul_t(xd2, tk.fp2_sqr_t(Yd)))
+        Y = tk.fp2_mul_t(
+            tk.fp2_mul_t(y, Yn),
+            tk.fp2_mul_t(tk.fp2_mul_t(xd2, tk.fp2_sqr_t(xd2)), tk.fp2_sqr_t(Yd)),
+        )
+        out_ref[:] = jnp.stack((X, Y, Z))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sswu_iso_t(u, interpret: bool):
+    t = u.shape[-1]
+    tile = _tile_for(t, 256)
+    t_pad = -(-t // tile) * tile
+    u = _pad_lanes(u, t_pad)
+    in_specs = _specs(
+        [((2, N_LIMBS), True), ((SQRT_RATIO_NBITS, 1), False),
+         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out = pl.pallas_call(
+        _sswu_iso_kernel,
+        out_shape=jax.ShapeDtypeStruct((3, 2, N_LIMBS, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
+        interpret=interpret,
+    )(u, _col(SQRT_RATIO_BITS), jnp.asarray(tk.CONSTS_NP))
+    return tuple(out[i, ..., :t] for i in range(3))
+
+
+# ------------------------------------------------------- cofactor clearing
+
+
+def _psi_t(P):
+    return (
+        tk.fp2_mul_t(tk.fp2_conj_t(P[0]), jnp.broadcast_to(_cpair("PSI_CX"), P[0].shape)),
+        tk.fp2_mul_t(tk.fp2_conj_t(P[1]), jnp.broadcast_to(_cpair("PSI_CY"), P[1].shape)),
+        tk.fp2_conj_t(P[2]),
+    )
+
+
+def _cofactor_kernel(pt_ref, k2bits_ref, xbits_ref, consts_ref, out_ref):
+    """(x^2-x-1) Q + (x-1) ψ(Q) + ψ(ψ(2Q)) — htc.clear_cofactor fused."""
+    with tk.bound_consts(consts_ref[:]):
+        F = tk.fp2_ops_t()
+        Q = (pt_ref[0], pt_ref[1], pt_ref[2])
+
+        def chain(bits_ref, nbits):
+            def step(i, acc):
+                acc = pt_double(F, acc)
+                cand = pt_add(F, acc, Q)
+                take = bits_ref[i, 0] == 1
+                return tuple(jnp.where(take, c, a) for c, a in zip(cand, acc))
+
+            return jax.lax.fori_loop(1, nbits, step, Q)
+
+        t0 = chain(k2bits_ref, K_X2_NBITS)
+        # (x-1) Q = -(|x|+1) Q; |x|+1 bit-table is xbits_ref.
+        t1 = _psi_t(pt_neg(F, chain(xbits_ref, xbits_ref.shape[0])))
+        t2 = _psi_t(_psi_t(pt_double(F, Q)))
+        out = pt_add(F, pt_add(F, t0, t1), t2)
+        out_ref[:] = jnp.stack(out)
+
+
+X_P1_BITS_NP = tk.bits_msb_first(-X_PARAM + 1)  # |x| + 1
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cofactor_t(P, interpret: bool):
+    t = P[0].shape[-1]
+    tile = _tile_for(t, 256)
+    t_pad = -(-t // tile) * tile
+    stacked = _pad_lanes(jnp.stack(P), t_pad)
+    in_specs = _specs(
+        [((3, 2, N_LIMBS), True), ((K_X2_NBITS, 1), False),
+         ((len(X_P1_BITS_NP), 1), False),
+         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        tile,
+    )
+    out = pl.pallas_call(
+        _cofactor_kernel,
+        out_shape=jax.ShapeDtypeStruct((3, 2, N_LIMBS, t_pad), jnp.int32),
+        grid=(t_pad // tile,),
+        in_specs=in_specs,
+        out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
+        interpret=interpret,
+    )(stacked, _col(K_X2_BITS_NP), _col(X_P1_BITS_NP),
+      jnp.asarray(tk.CONSTS_NP))
+    return tuple(out[i, ..., :t] for i in range(3))
+
+
+# ---------------------------------------------------------------- driver
+
+
+@jax.jit
+def _map_to_g2_fused(u):
+    """u [n, 2, 2, 48] (classic layout, Montgomery) -> transposed affine
+    (x, y [2,48,n], inf bool [n]) on G2."""
+    n = u.shape[0]
+    flat = jnp.moveaxis(u, 1, 0).reshape(2 * n, 2, 48)  # u0 lanes then u1
+    ut = tk.batch_to_t(flat)
+    X, Y, Z = _sswu_iso_t(ut, _interpret())
+    F2 = tk.fp2_ops_t()
+    Q = pt_add(
+        F2,
+        (X[..., :n], Y[..., :n], Z[..., :n]),
+        (X[..., n:], Y[..., n:], Z[..., n:]),
+    )
+    Qc = _cofactor_t(Q, _interpret())
+    return tc.to_affine_g2_t(Qc)
+
+
+def hash_to_g2_fused(msgs, dst=None):
+    """Full batched hash_to_curve through the fused kernels: messages ->
+    classic-layout affine (x[n,2,48], y[n,2,48], inf[n]) numpy arrays.
+    Host side is identical to htc.hash_to_g2_batch (SHA-256 + field
+    reduction); the curve mapping runs as two Pallas chains."""
+    from .htc import DST as _DST
+    from .htc import hash_to_field_dev
+
+    u = jnp.asarray(hash_to_field_dev(msgs, _DST if dst is None else dst))
+    x, y, inf = _map_to_g2_fused(u)
+    return (
+        np.asarray(tk.batch_from_t(x)),
+        np.asarray(tk.batch_from_t(y)),
+        np.asarray(inf),
+    )

@@ -53,6 +53,52 @@ def _embed_line(A, B, C, xp, yp):
     return _stk([c0, c1], -5)
 
 
+def _f6c(a, i):
+    return a[..., i, :, :, :]
+
+
+def _mul_by_01(x, a, b):
+    """fp6 x * (a + b v): 5 fp2 muls (vs 6 dense)."""
+    x0, x1, x2 = _f6c(x, 0), _f6c(x, 1), _f6c(x, 2)
+    m0 = fp2_mul_t(x0, a)
+    m1 = fp2_mul_t(x1, b)
+    mx = fp2_sub_t(
+        fp2_sub_t(fp2_mul_t(add_t(x0, x1), add_t(a, b)), m0), m1
+    )
+    c0 = add_t(m0, tk.fp2_mul_by_xi_t(fp2_mul_t(x2, b)))
+    c1 = mx
+    c2 = add_t(m1, fp2_mul_t(x2, a))
+    return _stk([c0, c1, c2], -4)
+
+
+def _mul_by_1(x, c):
+    """fp6 x * (c v): 3 fp2 muls."""
+    x0, x1, x2 = _f6c(x, 0), _f6c(x, 1), _f6c(x, 2)
+    return _stk(
+        [tk.fp2_mul_by_xi_t(fp2_mul_t(x2, c)), fp2_mul_t(x0, c),
+         fp2_mul_t(x1, c)],
+        -4,
+    )
+
+
+def _mul_line_sparse(f, line, xp, yp):
+    """f * line with the line kept sparse: the embedded element has only
+    slots (c0.c0, c0.c1, c1.c1) = (A, B*xp, C*yp) non-zero, so the
+    Karatsuba fp12 product needs 13 fp2 muls instead of the dense 18 —
+    and skips all the multiply-by-zero Montgomery work the dense embed
+    pays (blst calls this mul_by_xy00z0; VERDICT r1 item 4)."""
+    A, B, C = line
+    bxp = fp2_mul_fp_t(B, xp)
+    cyp = fp2_mul_fp_t(C, yp)
+    f0, f1 = f[..., 0, :, :, :, :], f[..., 1, :, :, :, :]
+    t0 = _mul_by_01(f0, A, bxp)                 # f0 * l0
+    t1 = _mul_by_1(f1, cyp)                     # f1 * l1
+    c0 = add_t(t0, tk.fp6_mul_by_v_t(t1))
+    f01 = add_t(f0, f1)
+    c1 = fp2_sub_t(fp2_sub_t(_mul_by_01(f01, A, add_t(bxp, cyp)), t0), t1)
+    return _stk([c0, c1], -5)
+
+
 def _dbl_step(T):
     """Double T + line through T scaled by 2YZ^3 (pairing.py _dbl_step)."""
     Xc, Yc, Zc = T
@@ -96,29 +142,64 @@ def _add_step(T, Qaff):
     return (X3, Y3, Z3), (lA, lB, lC)
 
 
-def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src):
+# Static segmentation of the Miller bit string: |x| has Hamming weight 6,
+# so only 5 of the 63 iterations take the add leg. The uniform
+# fori_loop-with-bit-table formulation paid the add step AND its dense
+# line multiplication on EVERY iteration (then discarded it by select) —
+# nearly half the kernel's work. The bits are compile-time constants, so
+# the loop is laid out as dbl-only fori runs with the 5 dbl+add steps
+# inlined at their exact positions.
+def _miller_segments():
+    segs = []  # (n_dbl_only_before, ) per add position, then tail count
+    run = 0
+    for b in MILLER_BITS_NP:
+        if b == 1:
+            segs.append(run)
+            run = 0
+        else:
+            run += 1
+    return segs, run
+
+
+_MILLER_ADD_RUNS, _MILLER_TAIL = _miller_segments()
+
+
+def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src=None):
     """Batched Miller loop (pairing.py miller_loop, transposed).
 
-    p_aff: (xp, yp) [.., 48, T]; q_aff: (xq, yq) [.., 2, 48, T];
-    inf masks [T]; bit_src: MILLER_NBITS int32 bits, indexable."""
+    p_aff: (xp, yp) [.., 48, T]; q_aff: (xq, yq) [.., 2, 48, T]; inf
+    masks [T]. The bit schedule is static (see _miller_segments);
+    ``bit_src`` is accepted for signature compatibility and ignored.
+    Line products are sparse (_mul_line_sparse)."""
     xp, yp = p_aff
     F2 = tk.fp2_ops_t()
     T0 = pt_from_affine(F2, q_aff[0], q_aff[1], q_inf)
     f0 = fp12_one_t(xp)
 
-    def step(i, carry):
+    def dbl_only(carry):
         f, T = carry
         f = fp12_sqr_t(f)
         T2, line = _dbl_step(T)
-        f = fp12_mul_t(f, _embed_line(*line, xp, yp))
-        Ta, line_a = _add_step(T2, q_aff)
-        fa = fp12_mul_t(f, _embed_line(*line_a, xp, yp))
-        take = bit_src[i, 0] == 1
-        f = jnp.where(take, fa, f)
-        T = tuple(jnp.where(take, a, b) for a, b in zip(Ta, T2))
-        return (f, T)
+        f = _mul_line_sparse(f, line, xp, yp)
+        return (f, T2)
 
-    f, _ = jax.lax.fori_loop(0, MILLER_NBITS, step, (f0, T0))
+    def run_dbls(carry, n):
+        if n == 0:
+            return carry
+        if n == 1:
+            return dbl_only(carry)
+        return jax.lax.fori_loop(0, n, lambda _i, c: dbl_only(c), carry)
+
+    carry = (f0, T0)
+    for run in _MILLER_ADD_RUNS:
+        carry = run_dbls(carry, run)
+        f, T = dbl_only(carry)
+        Ta, line_a = _add_step(T, q_aff)
+        f = _mul_line_sparse(f, line_a, xp, yp)
+        carry = (f, Ta)
+    carry = run_dbls(carry, _MILLER_TAIL)
+
+    f, _ = carry
     f = fp12_conj_t(f)  # x < 0
     trivial = p_inf | q_inf
     return jnp.where(trivial, fp12_one_t(xp), f)

@@ -38,15 +38,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..ops import limb
-from ..ops.pairing import final_exponentiation, fp12_tree_prod, miller_loop
+from ..ops.pairing import (
+    final_exponentiation,
+    fp12_fold_scan as _fold_fp12_scan,
+    fp12_tree_prod,
+    miller_loop,
+)
 from ..ops.points import (
     FP2_OPS,
     FP_OPS,
     G1_GEN_DEV,
     pt_add,
+    pt_fold_scan,
     pt_from_affine,
     pt_scalar_mul_bits,
-    pt_subgroup_check,
+    pt_subgroup_check_g2_fast,
     pt_to_affine,
     pt_tree_sum,
     pt_tree_sum_axis,
@@ -54,23 +60,12 @@ from ..ops.points import (
 from ..ops.tower import fp12_is_one, fp12_mul
 
 
-def _fold_points(F, parts, n: int):
-    """Sequential fold of n gathered partial-sum points (leading axis n).
-
-    n = a mesh axis size (small); a Python loop keeps no power-of-two
-    constraint on the mesh shape.
-    """
-    acc = tuple(c[0] for c in parts)
-    for i in range(1, n):
-        acc = pt_add(F, acc, tuple(c[i] for c in parts))
-    return acc
-
-
-def _fold_fp12(f_all, n: int):
-    acc = f_all[0]
-    for i in range(1, n):
-        acc = fp12_mul(acc, f_all[i])
-    return acc
+# Scan-based folds (ops/points.pt_fold_scan, ops/pairing.fp12_fold_scan):
+# ONE body in the graph regardless of mesh-axis size — a Python loop would
+# inline n-1 copies, and on the 1-core CPU host that compile cost is what
+# timed out the 8-device dryrun in round 1.
+_fold_points = pt_fold_scan
+_fold_fp12 = _fold_fp12_scan
 
 
 def make_mesh(n_devices: int | None = None, mp: int = 1) -> Mesh:
@@ -121,10 +116,10 @@ def build_sharded_verifier(mesh: Mesh):
         rpk = pt_scalar_mul_bits(FP_OPS, agg_aff[:2], agg_aff[2], r_bits)
         rsig = pt_scalar_mul_bits(FP2_OPS, (sx, sy), sinf, r_bits)
 
-        # Signature subgroup checks; global AND via psum of failure counts.
-        sig_j = pt_from_affine(FP2_OPS, sx, sy, sinf)
+        # Signature subgroup checks (ψ-criterion — 64-step chain, not the
+        # 255-step order multiply); global AND via psum of failure counts.
         bad_loc = jnp.sum(
-            jnp.where(pt_subgroup_check(FP2_OPS, sig_j), 0, 1)
+            jnp.where(pt_subgroup_check_g2_fast(sx, sy, sinf), 0, 1)
         )
         sub_ok = jax.lax.psum(bad_loc, "dp") == 0
 
@@ -136,26 +131,81 @@ def build_sharded_verifier(mesh: Mesh):
             FP2_OPS, tuple(c[None] for c in sig_acc)
         )
 
-        # Local Miller loops over this chip's sets, local product tree.
+        # ONE Miller-loop instance covers both the per-set pairs and the
+        # check pair e(-g1, sig_acc): the check pair rides as an extra lane
+        # (appended then padded to a power of two with infinity lanes, which
+        # contribute Fp12 one to the product tree). The pair is replicated
+        # across dp after the fold above, so it is masked to infinity on
+        # every chip but dp rank 0 — compiling a second [1]-shaped
+        # miller_loop for it would double the dominant compile cost.
         rpk_aff = pt_to_affine(FP_OPS, rpk)
-        f_loc = miller_loop(
-            (rpk_aff[0], rpk_aff[1]), rpk_aff[2], (mx, my), minf
-        )
-        f_loc = fp12_tree_prod(f_loc, S_loc)
+        n_lanes = S_loc + 1
+        n_pad = 1 << (n_lanes - 1).bit_length()
+        on_rank0 = jax.lax.axis_index("dp") == 0
 
-        # Fold Fp12 partials over dp, append the check pair e(-g1, sig_acc)
-        # (computed redundantly per chip — one Miller loop), finish.
+        def lanes(base, extra, pad_val):
+            ext = jnp.concatenate([base, extra[None] if extra.ndim < base.ndim else extra], 0)
+            if n_pad > n_lanes:
+                pad = jnp.broadcast_to(pad_val, (n_pad - n_lanes, *ext.shape[1:]))
+                ext = jnp.concatenate([ext, pad], 0)
+            return ext
+
+        neg_g1y = limb.neg(G1_GEN_DEV[1])
+        px = lanes(rpk_aff[0], G1_GEN_DEV[0], limb.ZERO_LIMBS)
+        py = lanes(rpk_aff[1], neg_g1y, limb.ZERO_LIMBS)
+        p_inf = jnp.concatenate(
+            [rpk_aff[2], ~on_rank0[None],
+             jnp.ones((n_pad - n_lanes,), bool)], 0
+        )
+        qx = lanes(mx, sig_acc_aff[0], FP2_OPS.zero)
+        qy = lanes(my, sig_acc_aff[1], FP2_OPS.zero)
+        q_inf = jnp.concatenate(
+            [minf, sig_acc_aff[2], jnp.ones((n_pad - n_lanes,), bool)], 0
+        )
+
+        f_loc = miller_loop((px, py), p_inf, (qx, qy), q_inf)
+        f_loc = fp12_tree_prod(f_loc, n_pad)
+
+        # Fold Fp12 partials over dp, then the (replicated) final exp.
         f_all = jax.lax.all_gather(f_loc, "dp")
         f = _fold_fp12(f_all, dp)
-        neg_g1 = (G1_GEN_DEV[0][None], limb.neg(G1_GEN_DEV[1])[None])
-        f_chk = miller_loop(
-            neg_g1,
-            jnp.zeros((1,), bool),
-            (sig_acc_aff[0], sig_acc_aff[1]),
-            sig_acc_aff[2],
-        )
-        f = fp12_mul(f, f_chk[0])
         f = final_exponentiation(f)
         return (fp12_is_one(f) & sub_ok)[None]
+
+    return body
+
+
+def build_sharded_fused_verifier(mesh: Mesh):
+    """Sharded PRODUCTION verifier: the fused Pallas pipeline
+    (jax_backend._verify_core_fused) with its set axis laid over "dp".
+
+    Unlike :func:`build_sharded_verifier` (the classic-XLA program this
+    module originally sharded), this is the same code path single-chip
+    production uses — the collectives are the `axis="dp"` hooks inside
+    the fused core, so verify_signature_sets reaches N chips through one
+    body. K (pubkeys-per-set) stays chip-local: the fused kernels batch
+    it on lanes, and a 512-key sync-committee aggregation tree costs
+    log2(512) batched adds — cheaper than an "mp" axis round-trip.
+    """
+    from ..jax_backend import _verify_core_fused
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp"), P("dp"), P("dp"),  # pk x/y/inf  [S, K, ...]
+            P("dp"), P("dp"), P("dp"),  # sig x/y/inf
+            P("dp"), P("dp"), P("dp"),  # msg x/y/inf
+            P("dp"),                    # r_bits
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def body(pk_x, pk_y, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        ok = _verify_core_fused(
+            (pk_x, pk_y), pk_inf, (sx, sy), sinf, (mx, my), minf, r_bits,
+            axis="dp",
+        )
+        return ok[None]
 
     return body

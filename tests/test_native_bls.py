@@ -1,0 +1,111 @@
+"""Native C++ BLS12-381 (native/bls12381.cpp) vs the Python oracle.
+
+The native library is the measured CPU baseline; these tests pin it to the
+same RFC-anchored semantics as the oracle and the device backends:
+hash-to-G2 parity, full-pairing parity, bilinearity, and RLC batch-verify
+agreement on valid / tampered / structurally-invalid sets.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.api import (
+    AggregateSignature,
+    SecretKey,
+    SignatureSet,
+)
+from lighthouse_tpu.crypto.bls.curve import g1_generator, g2_generator
+from lighthouse_tpu.crypto.bls.fields import Fq2
+from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.crypto.bls.native_backend import (
+    _pack_g1,
+    _pack_g2,
+    load_native_backend,
+)
+from lighthouse_tpu.crypto.bls.pairing import pairing
+
+backend = load_native_backend()
+pytestmark = pytest.mark.skipif(
+    backend is None, reason="native toolchain unavailable"
+)
+
+
+def _g2_from_bytes(raw: bytes) -> tuple[Fq2, Fq2]:
+    x = Fq2(int.from_bytes(raw[0:48], "big"), int.from_bytes(raw[48:96], "big"))
+    y = Fq2(int.from_bytes(raw[96:144], "big"), int.from_bytes(raw[144:192], "big"))
+    return x, y
+
+
+def test_hash_to_g2_parity():
+    for msg in (b"", b"abc", bytes(range(32)), b"lighthouse-tpu-native"):
+        raw, inf = backend.hash_to_g2_bytes(msg)
+        want = hash_to_g2(msg)
+        assert not inf
+        x, y = _g2_from_bytes(raw)
+        assert x == want.x and y == want.y
+
+
+def test_pairing_parity_and_bilinearity():
+    g1, g2 = g1_generator(), g2_generator()
+    e_ab = backend.pairing_bytes(_pack_g1(g1.mul(5)), _pack_g2(g2.mul(7)))
+    e_ba = backend.pairing_bytes(_pack_g1(g1.mul(7)), _pack_g2(g2.mul(5)))
+    e_1 = backend.pairing_bytes(_pack_g1(g1.mul(35)), _pack_g2(g2))
+    assert e_ab == e_ba == e_1
+
+    # Oracle parity: e(2g1, 3g2) coefficient-by-coefficient.
+    raw = backend.pairing_bytes(_pack_g1(g1.mul(2)), _pack_g2(g2.mul(3)))
+    want = pairing(g1.mul(2), g2.mul(3))
+    coeffs = []
+    for six in (want.c0, want.c1):
+        for two in (six.c0, six.c1, six.c2):
+            coeffs += [two.c0, two.c1]
+    got = [int.from_bytes(raw[i * 48 : (i + 1) * 48], "big") for i in range(12)]
+    assert got == coeffs
+
+
+def _sets(n=3):
+    sks = [SecretKey.from_int(i + 11) for i in range(4)]
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    out = [
+        SignatureSet.single_pubkey(
+            sks[0].sign(msgs[0]), sks[0].public_key(), msgs[0]
+        ),
+        SignatureSet.multiple_pubkeys(
+            AggregateSignature.aggregate(
+                [sks[1].sign(msgs[1]), sks[2].sign(msgs[1])]
+            ),
+            [sks[1].public_key(), sks[2].public_key()],
+            msgs[1],
+        ),
+        SignatureSet.single_pubkey(
+            sks[3].sign(msgs[2]), sks[3].public_key(), msgs[2]
+        ),
+    ]
+    return out[:n]
+
+
+def test_verify_batch_valid():
+    assert backend.verify_signature_sets(_sets())
+
+
+def test_verify_batch_tampered():
+    sets = _sets()
+    bad = SignatureSet.single_pubkey(
+        sets[0].signature, sets[0].signing_keys[0], sets[2].message
+    )
+    assert not backend.verify_signature_sets([bad, sets[1], sets[2]])
+
+
+def test_verify_batch_structural():
+    sets = _sets(1)
+    assert not backend.verify_signature_sets([])
+    empty = SignatureSet(sets[0].signature, [], sets[0].message)
+    assert not backend.verify_signature_sets([empty])
+
+
+def test_matches_python_backend():
+    from lighthouse_tpu.crypto.bls.backends import get_backend
+
+    sets = _sets()
+    assert backend.verify_signature_sets(sets) == get_backend(
+        "python"
+    ).verify_signature_sets(sets)

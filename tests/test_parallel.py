@@ -111,6 +111,53 @@ def test_graft_dryrun_multichip():
     __graft_entry__.dryrun_multichip(4)
 
 
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+@big_stack_thread
+def test_sharded_fused_matches_oracle():
+    """VERDICT r1 item 7: the PRODUCTION (fused Pallas, interpret mode on
+    CPU) verifier sharded over a 4-chip dp mesh, vs the oracle verdicts —
+    one code path from verify_signature_sets to N chips."""
+    from lighthouse_tpu.parallel import build_sharded_fused_verifier, make_mesh
+
+    S, K = 4, 2
+    sks = [SecretKey.from_int(i + 51) for i in range(5)]
+    msgs = [bytes([i + 9]) * 32 for i in range(4)]
+    sets = [
+        SignatureSet.single_pubkey(sks[0].sign(msgs[0]), sks[0].public_key(), msgs[0]),
+        SignatureSet.multiple_pubkeys(
+            AggregateSignature.aggregate([sks[1].sign(msgs[1]), sks[2].sign(msgs[1])]),
+            [sks[1].public_key(), sks[2].public_key()],
+            msgs[1],
+        ),
+        SignatureSet.single_pubkey(sks[3].sign(msgs[2]), sks[3].public_key(), msgs[2]),
+        SignatureSet.single_pubkey(sks[4].sign(msgs[3]), sks[4].public_key(), msgs[3]),
+    ]
+    mesh = make_mesh(4, mp=1)
+    fn = jax.jit(build_sharded_fused_verifier(mesh))
+
+    good = _flat_batch(sets, S, K)
+    assert bool(fn(*good)[0])
+
+    bad = list(good)
+    sx = np.array(good[3])
+    sx[[0, 1]] = sx[[1, 0]]
+    bad[3] = sx
+    assert not bool(fn(*bad)[0])
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@big_stack_thread
+def test_graft_dryrun_multichip_8():
+    """The driver's exact 8-device gate (VERDICT r1: rc=124 timeout).
+
+    dryrun_multichip asserts the sharded verdict is True, so this is a
+    correctness check of the dp=4 x mp=2 collectives, under the dryrun's
+    fast-compile config."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+
+
 def test_graft_entry_shapes():
     import __graft_entry__
 

@@ -1,0 +1,177 @@
+"""Socket transport (network/socket_transport.py): framing, gossip
+fan-out, req/resp, UDP discovery — and the VERDICT r1 item 8 gate: two OS
+PROCESSES syncing and finalizing together over TCP.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network.service import NetworkService
+from lighthouse_tpu.network.socket_transport import (
+    SocketHub,
+    SocketPeer,
+    UdpDiscoveryServer,
+    discover_and_connect,
+    udp_find,
+    udp_register,
+)
+from lighthouse_tpu.network import snappy
+
+
+def _wire(payload: bytes) -> bytes:
+    return snappy.compress(payload)
+
+
+def test_gossip_and_rpc_between_socket_peers():
+    a = SocketPeer("a")
+    b = SocketPeer("b")
+    c = SocketPeer("c")
+    try:
+        b.connect(a.host, a.port)
+        c.connect(b.host, b.port)  # chain topology: a - b - c
+        for p in (a, b, c):
+            p.subscribe("topic")
+        time.sleep(0.05)  # SUB control frames propagate
+
+        a.publish("topic", _wire(b"hello world"))
+        assert b.wait_for_messages(2.0)
+        b.deliver_pending()
+        # fan-out: c is NOT connected to a; the message must arrive via b
+        assert c.wait_for_messages(2.0)
+        got = []
+        c.on_gossip = lambda t, m, w, s: got.append(
+            (t, snappy.decompress(w), s)
+        )
+        c.deliver_pending()
+        assert got == [("topic", b"hello world", "b")]
+
+        # req/resp both directions
+        a.register_rpc("proto", lambda src, w: [w + b"!", b"chunk2"])
+        assert b.request("a", "proto", _wire(b"x") * 0 + b"req") == [
+            b"req!", b"chunk2"
+        ]
+        with pytest.raises(ConnectionError):
+            b.request("a", "missing", b"req")
+    finally:
+        for p in (a, b, c):
+            p.close()
+
+
+def test_udp_discovery_roundtrip():
+    boot = UdpDiscoveryServer()
+    a = SocketPeer("a")
+    b = SocketPeer("b")
+    try:
+        assert udp_register(
+            (boot.host, boot.port),
+            {"peer_id": "a", "host": a.host, "port": a.port},
+        )
+        recs = udp_find((boot.host, boot.port))
+        assert [r["peer_id"] for r in recs] == ["a"]
+        assert discover_and_connect(b, (boot.host, boot.port)) == 1
+        time.sleep(0.05)
+        assert "b" in a.connected_peers()
+    finally:
+        boot.close()
+        a.close()
+        b.close()
+
+
+_CHILD = r"""
+import json, sys, time
+sys.path.insert(0, "@REPO@")
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network.service import NetworkService
+from lighthouse_tpu.network.socket_transport import SocketHub
+
+parent_host, parent_port, n_slots = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+h = BeaconChainHarness(validator_count=16)       # same deterministic genesis
+h.slot_clock.set_slot(n_slots)  # both processes "live at" the target slot
+svc = NetworkService(h.chain, SocketHub(), "child")
+svc.peer.connect(parent_host, parent_port)
+time.sleep(0.1)
+
+# Status handshake triggers range sync up to the parent's head.
+status = svc.send_status("parent")
+assert status is not None, "no status from parent"
+deadline = time.monotonic() + 60
+# Then follow gossip until the parent's chain reaches n_slots.
+while time.monotonic() < deadline:
+    svc.poll()
+    if int(h.chain.head().block.message.slot) >= n_slots:
+        break
+    time.sleep(0.02)
+
+head = h.chain.head()
+print(json.dumps({
+    "head_slot": int(head.block.message.slot),
+    "head_root": head.root.hex(),
+    "finalized_epoch": int(head.state.finalized_checkpoint.epoch),
+}))
+"""
+
+
+def test_two_process_sync_and_finalize(tmp_path):
+    """Parent produces 3+ epochs of blocks; a CHILD OS PROCESS connects
+    over TCP, range-syncs, follows gossip, and lands on the same
+    finalized head."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = BeaconChainHarness(validator_count=16)
+    svc = NetworkService(h.chain, SocketHub(), "parent")
+
+    epoch_slots = h.spec.preset.SLOTS_PER_EPOCH
+    # two epochs of history before the child appears
+    h.extend_chain(2 * epoch_slots)
+
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.replace("@REPO@", repo))
+    n_slots = 5 * epoch_slots + 2
+    child = subprocess.Popen(
+        [sys.executable, str(script), svc.peer.host, str(svc.peer.port),
+         str(n_slots)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # wait for the child to dial in
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if "child" in svc.peer.connected_peers():
+                break
+            time.sleep(0.05)
+        assert "child" in svc.peer.connected_peers(), "child never connected"
+        time.sleep(0.3)  # let the child finish its range sync
+
+        # live blocks over gossip up to n_slots (extend_chain's pattern,
+        # publishing each block)
+        while h.head_slot() < n_slots:
+            slot = h.advance_slot()
+            block = h.make_block(slot)
+            h.chain.process_block(block, block_delay_seconds=0.0)
+            svc.publish_block(block)
+            h.attest(slot)
+
+        out, err = child.communicate(timeout=90)
+        assert child.returncode == 0, f"child failed:\n{err[-2000:]}"
+        result = json.loads(out.strip().splitlines()[-1])
+    finally:
+        if child.poll() is None:
+            child.kill()
+        svc.peer.close()
+
+    parent_head = h.chain.head()
+    assert result["head_root"] == parent_head.root.hex(), (
+        result, parent_head.root.hex()
+    )
+    assert result["head_slot"] == int(parent_head.block.message.slot)
+    # both finalized: ≥ 1 full epoch behind head after 5 epochs of voting
+    assert result["finalized_epoch"] >= 1
+    assert result["finalized_epoch"] == int(
+        parent_head.state.finalized_checkpoint.epoch
+    )

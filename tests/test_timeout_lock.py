@@ -1,0 +1,92 @@
+"""TimeoutRwLock (common/timeout_lock.py) — the TimeoutRwLock analog."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.common.timeout_lock import LockTimeout, TimeoutRwLock
+
+
+def test_concurrent_readers():
+    lock = TimeoutRwLock()
+    order = []
+
+    def second_reader():
+        with lock.read(timeout=0.5):
+            order.append("r2")
+
+    with lock.read():
+        t = threading.Thread(target=second_reader)
+        t.start()
+        t.join(1)
+        assert order == ["r2"]  # second reader not blocked
+
+
+def test_writer_times_out_under_reader():
+    lock = TimeoutRwLock(timeout=0.05)
+    with lock.read():
+        t0 = time.monotonic()
+        with pytest.raises(LockTimeout):
+            with lock.write():
+                pass
+        assert time.monotonic() - t0 < 1.0
+
+
+def test_reader_times_out_under_writer():
+    lock = TimeoutRwLock(timeout=0.05)
+    with lock.write():
+        with pytest.raises(LockTimeout):
+            with lock.read():
+                pass
+
+
+def test_write_excludes_and_releases():
+    lock = TimeoutRwLock()
+    results = []
+
+    def writer():
+        with lock.write():
+            results.append("w")
+
+    with lock.read():
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.05)
+        assert results == []  # writer blocked while read held
+    t.join(1)
+    assert results == ["w"]
+
+
+def test_timeout_metric_increments():
+    from lighthouse_tpu.common.timeout_lock import _TIMEOUTS
+
+    before = _TIMEOUTS.value() if hasattr(_TIMEOUTS, "value") else None
+    lock = TimeoutRwLock(timeout=0.01)
+    with lock.write():
+        with pytest.raises(LockTimeout):
+            with lock.read():
+                pass
+    if before is not None:
+        assert _TIMEOUTS.value() == before + 1
+
+
+def test_disabled_waits_forever_released():
+    lock = TimeoutRwLock(timeout=0.01)
+    TimeoutRwLock.enabled = False
+    try:
+        done = []
+
+        def reader():
+            with lock.read():
+                done.append(True)
+
+        with lock.write():
+            t = threading.Thread(target=reader)
+            t.start()
+            time.sleep(0.05)
+            assert not done  # still waiting, not timed out
+        t.join(1)
+        assert done
+    finally:
+        TimeoutRwLock.enabled = True
