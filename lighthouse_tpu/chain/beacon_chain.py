@@ -533,6 +533,7 @@ class BeaconChain:
         except LockTimeout:
             err = AttestationError("pubkey cache lock timeout")
             return [err for _ in attestations]
+        batch_seen: set[tuple[int, int]] = set()
         try:
             for att in attestations:
                 try:
@@ -541,8 +542,17 @@ class BeaconChain:
                         raise AttestationError("unaggregated attestation must set one bit")
                     vi = int(indexed.attesting_indices[0])
                     epoch = int(att.data.target.epoch)
-                    if self.observed_attesters.is_known(epoch, vi):
+                    # observed_attesters only records AFTER verification,
+                    # so intra-batch duplicates AND same-epoch
+                    # equivocations (same attester, different vote) must
+                    # be caught batch-locally — matching what the
+                    # sequential path rejects as 'prior seen'.
+                    if (
+                        self.observed_attesters.is_known(epoch, vi)
+                        or (epoch, vi) in batch_seen
+                    ):
                         raise AttestationError("duplicate attestation (prior seen)")
+                    batch_seen.add((epoch, vi))
                     sig_set = sigs.indexed_attestation_signature_set(
                         self._head.state,
                         self.pubkey_cache.as_getter(),

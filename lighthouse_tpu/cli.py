@@ -86,6 +86,35 @@ def build_parser() -> argparse.ArgumentParser:
     imp = asub.add_parser("inspect", help="inspect a keystore")
     imp.add_argument("path")
     imp.add_argument("--password", default=None)
+    wallet = asub.add_parser(
+        "wallet", help="EIP-2386 wallet create/recover/derive"
+    )
+    wallet.add_argument("action2", choices=("create", "recover", "validator"),
+                        metavar="create|recover|validator")
+    wallet.add_argument("--name", default="wallet")
+    wallet.add_argument("--password", required=True)
+    wallet.add_argument("--seed-hex", default=None,
+                        help="recover: the seed backup; create: optional")
+    wallet.add_argument("--wallet-file", default=None,
+                        help="validator: wallet JSON path (updated in place)")
+    wallet.add_argument("--keystore-password", default=None)
+    wallet.add_argument("--count", type=int, default=1)
+    wallet.add_argument("--out", default="-")
+
+    ex = asub.add_parser(
+        "exit", help="sign + publish a voluntary exit (validator exit flow)"
+    )
+    ex.add_argument("--keystore", required=True)
+    ex.add_argument("--password", required=True)
+    ex.add_argument("--validator-index", type=int, required=True)
+    ex.add_argument("--epoch", type=int, required=True)
+    ex.add_argument("--beacon-node", default=None,
+                    help="BN URL to publish to; omit to just print the exit")
+    ex.add_argument("--genesis-validators-root", required=True)
+    ex.add_argument("--current-epoch", type=int, default=None,
+                    help="the chain's current epoch (fetched from the BN "
+                         "when --beacon-node is given; defaults to --epoch)")
+
     sp = asub.add_parser(
         "slashing-protection", help="EIP-3076 interchange import/export"
     )
@@ -102,6 +131,20 @@ def build_parser() -> argparse.ArgumentParser:
     ig = lsub.add_parser("interop-genesis")
     ig.add_argument("--validator-count", type=int, default=64)
     ig.add_argument("--genesis-time", type=int, default=1_600_000_000)
+    nt = lsub.add_parser("new-testnet",
+                         help="write a network config bundle + genesis state")
+    nt.add_argument("--out", required=True, help="output directory")
+    nt.add_argument("--validator-count", type=int, default=64)
+    nt.add_argument("--genesis-time", type=int, default=1_600_000_000)
+    nt.add_argument("--name", default="local-testnet")
+    nt.add_argument("--altair-fork-epoch", type=int, default=None)
+    nt.add_argument("--bellatrix-fork-epoch", type=int, default=None)
+    eg = lsub.add_parser("eth1-genesis",
+                         help="genesis state from signed deposits (the "
+                              "deposit-contract path)")
+    eg.add_argument("--validator-count", type=int, default=16)
+    eg.add_argument("--eth1-block-hash", default="0x" + "42" * 32)
+    eg.add_argument("--eth1-timestamp", type=int, default=1_606_824_000)
     sk = lsub.add_parser("skip-slots")
     sk.add_argument("--slots", type=int, required=True)
     sk.add_argument("--validator-count", type=int, default=16)
@@ -268,6 +311,113 @@ def run_account(args) -> int:
             info["decrypts"] = True
         print(json.dumps(info, indent=2))
         return 0
+    if args.action == "wallet":
+        from .validator.wallet import Wallet
+
+        if args.action2 in ("create", "recover"):
+            seed = (
+                bytes.fromhex(args.seed_hex.removeprefix("0x"))
+                if args.seed_hex
+                else None
+            )
+            if args.action2 == "recover" and seed is None:
+                print(json.dumps({"error": "recover requires --seed-hex"}),
+                      file=sys.stderr)
+                return 1
+            wallet = Wallet.create(args.name, args.password, seed=seed)
+            # round-trip guard: the wallet must decrypt back to the seed
+            recovered = wallet.decrypt_seed(args.password)
+            if seed is not None and recovered != seed:
+                print(json.dumps({"error": "seed round-trip failed"}),
+                      file=sys.stderr)
+                return 1
+            out = wallet.to_json()
+            if args.out == "-":
+                print(out)
+            else:
+                with open(args.out, "w") as f:
+                    f.write(out)
+            if args.action2 == "create" and args.seed_hex is None:
+                # the backup material (the reference prints a mnemonic)
+                print(json.dumps({"seed_backup": "0x" + recovered.hex()}),
+                      file=sys.stderr)
+            return 0
+        # action2 == "validator": derive the next N keystores
+        if not args.wallet_file or not args.keystore_password:
+            print(json.dumps({"error": "--wallet-file and "
+                              "--keystore-password required"}),
+                  file=sys.stderr)
+            return 1
+        with open(args.wallet_file) as f:
+            wallet = Wallet.from_json(f.read())
+        keystores = [
+            json.loads(
+                wallet.next_validator(
+                    args.password, args.keystore_password
+                ).to_json()
+            )
+            for _ in range(args.count)
+        ]
+        with open(args.wallet_file, "w") as f:
+            f.write(wallet.to_json())  # persists nextaccount
+        out = json.dumps(keystores, indent=2)
+        if args.out == "-":
+            print(out)
+        else:
+            with open(args.out, "w") as f:
+                f.write(out)
+        return 0
+    if args.action == "exit":
+        from .api import BeaconNodeClient
+        from .consensus.config import compute_signing_root
+        from .consensus.types import SignedVoluntaryExit, VoluntaryExit
+        from .validator.keystore import Keystore
+
+        with open(args.keystore) as f:
+            sk = Keystore.from_json(f.read()).decrypt(args.password)
+        spec = _spec_for(args.spec)
+        gvr = bytes.fromhex(args.genesis_validators_root.removeprefix("0x"))
+        msg = VoluntaryExit(
+            epoch=args.epoch, validator_index=args.validator_index
+        )
+        # Sign under the Fork container the chain CURRENTLY carries (the
+        # verifier's get_domain picks previous_version for pre-fork exit
+        # epochs — two forks later that is NOT the exit epoch's own
+        # version). Prefer the BN's live view of the current epoch.
+        current_epoch = args.current_epoch
+        if current_epoch is None and args.beacon_node:
+            from .api import BeaconNodeClient
+
+            head = BeaconNodeClient(url=args.beacon_node).get_header()
+            slot = int(head["data"]["header"]["message"]["slot"])
+            current_epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        if current_epoch is None:
+            current_epoch = args.epoch
+        domain = spec.get_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT,
+            args.epoch,
+            spec.fork_at_epoch(current_epoch),
+            gvr,
+        )
+        signed = SignedVoluntaryExit(
+            message=msg,
+            signature=sk.sign(compute_signing_root(msg, domain)).to_bytes(),
+        )
+        exit_json = {
+            "message": {
+                "epoch": str(args.epoch),
+                "validator_index": str(args.validator_index),
+            },
+            "signature": "0x" + bytes(signed.signature).hex(),
+        }
+        if args.beacon_node:
+            BeaconNodeClient(url=args.beacon_node).post_voluntary_exit(
+                exit_json
+            )
+            print(json.dumps({"published": True, **exit_json}))
+        else:
+            print(json.dumps(exit_json, indent=2))
+        return 0
     if args.action == "slashing-protection":
         from .validator.slashing_protection import SlashingDatabase
 
@@ -322,6 +472,83 @@ def run_lcli(args) -> int:
             + bytes(state.genesis_validators_root).hex(),
             "genesis_time": int(state.genesis_time),
             "validators": len(state.validators),
+        }))
+        return 0
+    if args.action == "new-testnet":
+        # lcli new_testnet: a network config bundle another node can boot
+        # from (eth2_network_config layout: config.yaml + genesis.ssz +
+        # boot_enr.yaml; network_config.load_testnet_dir reads it back).
+        import os
+
+        from .consensus.genesis import interop_genesis_state, interop_keypairs
+        from .crypto.bls import backends as bls_backends
+
+        prev = bls_backends._default
+        bls_backends.set_default_backend("fake")
+        try:
+            state = interop_genesis_state(
+                interop_keypairs(args.validator_count), args.genesis_time,
+                spec, sign_deposits=False,
+            )
+        finally:
+            bls_backends._default = prev
+        os.makedirs(args.out, exist_ok=True)
+        config = {
+            "CONFIG_NAME": args.name,
+            "PRESET_BASE": args.spec,
+            "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": args.validator_count,
+            "MIN_GENESIS_TIME": args.genesis_time,
+            "GENESIS_FORK_VERSION": "0x" + spec.GENESIS_FORK_VERSION.hex(),
+            "SECONDS_PER_SLOT": spec.SECONDS_PER_SLOT,
+        }
+        if args.altair_fork_epoch is not None:
+            config["ALTAIR_FORK_EPOCH"] = args.altair_fork_epoch
+            config["ALTAIR_FORK_VERSION"] = (
+                "0x" + spec.ALTAIR_FORK_VERSION.hex()
+            )
+        if args.bellatrix_fork_epoch is not None:
+            config["BELLATRIX_FORK_EPOCH"] = args.bellatrix_fork_epoch
+            config["BELLATRIX_FORK_VERSION"] = (
+                "0x" + spec.BELLATRIX_FORK_VERSION.hex()
+            )
+        with open(os.path.join(args.out, "config.yaml"), "w") as f:
+            for k, v in config.items():
+                f.write(f"{k}: {v}\n")
+        with open(os.path.join(args.out, "genesis.ssz"), "wb") as f:
+            f.write(state.encode())
+        with open(os.path.join(args.out, "boot_enr.yaml"), "w") as f:
+            f.write("[]\n")
+        print(json.dumps({
+            "out": args.out,
+            "genesis_validators_root": "0x"
+            + bytes(state.genesis_validators_root).hex(),
+            "validators": len(state.validators),
+        }))
+        return 0
+    if args.action == "eth1-genesis":
+        # lcli eth1_genesis: the deposit-contract path — REAL signed
+        # deposits through initialize_beacon_state_from_eth1.
+        from .consensus.genesis import (
+            genesis_deposits,
+            initialize_beacon_state_from_eth1,
+            interop_keypairs,
+        )
+
+        keys = interop_keypairs(args.validator_count)
+        deposits = genesis_deposits(
+            keys, spec.preset.MAX_EFFECTIVE_BALANCE, spec, sign=True
+        )
+        state = initialize_beacon_state_from_eth1(
+            bytes.fromhex(args.eth1_block_hash.removeprefix("0x")),
+            args.eth1_timestamp,
+            deposits,
+            spec,
+        )
+        print(json.dumps({
+            "genesis_validators_root": "0x"
+            + bytes(state.genesis_validators_root).hex(),
+            "validators": len(state.validators),
+            "genesis_time": int(state.genesis_time),
         }))
         return 0
     if args.action == "skip-slots":

@@ -155,3 +155,48 @@ def spec_for_network(name: str) -> ChainSpec:
 
 def boot_nodes(name: str) -> list[str]:
     return list(BUILT_IN.get(name, {}).get("boot_enr", []))
+
+
+def load_testnet_dir(path: str):
+    """Boot from an `lcli new-testnet` bundle (or any directory in the
+    eth2_network_config layout): config.yaml + genesis.ssz [+
+    boot_enr.yaml]. Returns (ChainSpec, genesis_state_bytes, boot_enrs)
+    — the testnet-dir twin of the reference's Eth2NetworkConfig::load
+    (eth2_network_config/src/lib.rs)."""
+    import os
+
+    cfg: dict = {}
+    with open(os.path.join(path, "config.yaml")) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or ":" not in line:
+                continue
+            k, v = (x.strip() for x in line.split(":", 1))
+            cfg[k] = v
+
+    base = minimal_spec() if cfg.get("PRESET_BASE") == "minimal" else mainnet_spec()
+    updates: dict = {"name": cfg.get("CONFIG_NAME", os.path.basename(path))}
+    for key in (
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT", "MIN_GENESIS_TIME",
+        "GENESIS_DELAY", "SECONDS_PER_SLOT", "ETH1_FOLLOW_DISTANCE",
+        "ALTAIR_FORK_EPOCH", "BELLATRIX_FORK_EPOCH", "DEPOSIT_CHAIN_ID",
+    ):
+        if key in cfg and hasattr(base, key):
+            updates[key] = int(cfg[key])
+    for key in (
+        "GENESIS_FORK_VERSION", "ALTAIR_FORK_VERSION", "BELLATRIX_FORK_VERSION",
+    ):
+        if key in cfg and hasattr(base, key):
+            updates[key] = _ver(cfg[key])
+    spec = dataclasses.replace(base, **updates)
+
+    with open(os.path.join(path, "genesis.ssz"), "rb") as f:
+        genesis = f.read()
+    enrs: list[str] = []
+    enr_path = os.path.join(path, "boot_enr.yaml")
+    if os.path.exists(enr_path):
+        import yaml as _yaml
+
+        with open(enr_path) as f:
+            enrs = _yaml.safe_load(f) or []
+    return spec, genesis, enrs

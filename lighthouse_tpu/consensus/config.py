@@ -210,6 +210,31 @@ class ChainSpec:
     def fork_version_at_epoch(self, epoch: int) -> bytes:
         return self.fork_version_for_name(self.fork_name_at_epoch(epoch))
 
+    def fork_at_epoch(self, epoch: int):
+        """The Fork container a state at ``epoch`` carries — what domain
+        verification actually reads (get_domain picks previous_version for
+        pre-fork epochs). Offline signers (account exit) must use THIS,
+        not fork_version_at_epoch of the message's own epoch, or their
+        signatures diverge from the chain once two forks have passed."""
+        from .types import Fork
+
+        schedule = [("phase0", 0)]
+        if self.ALTAIR_FORK_EPOCH is not None:
+            schedule.append(("altair", self.ALTAIR_FORK_EPOCH))
+        if self.BELLATRIX_FORK_EPOCH is not None:
+            schedule.append(("bellatrix", self.BELLATRIX_FORK_EPOCH))
+        cur = 0
+        for i, (_name, e) in enumerate(schedule):
+            if epoch >= e:
+                cur = i
+        name, fork_epoch = schedule[cur]
+        prev = schedule[cur - 1][0] if cur > 0 else name
+        return Fork(
+            previous_version=self.fork_version_for_name(prev),
+            current_version=self.fork_version_for_name(name),
+            epoch=fork_epoch,
+        )
+
     # -- domains (reference: chain_spec.rs:343,410) --------------------------
     def compute_fork_data_root(
         self, current_version: bytes, genesis_validators_root: bytes

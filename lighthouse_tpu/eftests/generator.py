@@ -657,59 +657,64 @@ def _gen_ssz_static_breadth(root: str) -> None:
         "lighthouse_tpu.consensus.helpers", fromlist=["get_indexed_attestation"]
     ).get_indexed_attestation(state, att, spec)
 
+    def T(name):
+        # preset-parameterized containers live on the spec_types bundle;
+        # preset-independent ones at module level
+        return getattr(t, name, None) or getattr(ct, name)
+
     objs = {
         "Attestation": att,
         "AttestationData": att.data,
-        "AttesterSlashing": ct.AttesterSlashing(
+        "AttesterSlashing": T("AttesterSlashing")(
             attestation_1=indexed, attestation_2=indexed
         ),
-        "BeaconBlockHeader": ct.BeaconBlockHeader(
+        "BeaconBlockHeader": T("BeaconBlockHeader")(
             slot=1, proposer_index=2, parent_root=b"\x01" * 32,
             state_root=b"\x02" * 32, body_root=b"\x03" * 32,
         ),
         "Checkpoint": att.data.target,
-        "DepositData": ct.DepositData(
+        "DepositData": T("DepositData")(
             pubkey=b"\x11" * 48, withdrawal_credentials=b"\x22" * 32,
             amount=32 * 10**9, signature=b"\x33" * 96,
         ),
-        "DepositMessage": ct.DepositMessage(
+        "DepositMessage": T("DepositMessage")(
             pubkey=b"\x11" * 48, withdrawal_credentials=b"\x22" * 32,
             amount=32 * 10**9,
         ),
         "Eth1Data": state.eth1_data,
         "Fork": state.fork,
-        "ForkData": ct.ForkData(
+        "ForkData": T("ForkData")(
             current_version=b"\x00\x00\x00\x01",
             genesis_validators_root=b"\x42" * 32,
         ),
         "IndexedAttestation": indexed,
-        "PendingAttestation": ct.PendingAttestation(
+        "PendingAttestation": T("PendingAttestation")(
             aggregation_bits=att.aggregation_bits, data=att.data,
             inclusion_delay=1, proposer_index=0,
         ),
-        "SignedBeaconBlockHeader": ct.SignedBeaconBlockHeader(
-            message=ct.BeaconBlockHeader(
+        "SignedBeaconBlockHeader": T("SignedBeaconBlockHeader")(
+            message=T("BeaconBlockHeader")(
                 slot=1, proposer_index=2, parent_root=b"\x01" * 32,
                 state_root=b"\x02" * 32, body_root=b"\x03" * 32,
             ),
             signature=b"\x44" * 96,
         ),
-        "SigningData": ct.SigningData(
+        "SigningData": T("SigningData")(
             object_root=b"\x55" * 32, domain=b"\x66" * 32
         ),
         "Validator": state.validators[0],
-        "VoluntaryExit": ct.VoluntaryExit(epoch=3, validator_index=4),
-        "SignedVoluntaryExit": ct.SignedVoluntaryExit(
-            message=ct.VoluntaryExit(epoch=3, validator_index=4),
+        "VoluntaryExit": T("VoluntaryExit")(epoch=3, validator_index=4),
+        "SignedVoluntaryExit": T("SignedVoluntaryExit")(
+            message=T("VoluntaryExit")(epoch=3, validator_index=4),
             signature=b"\x77" * 96,
         ),
     }
     # Deposit carries a Vector[Bytes32, 33] proof.
-    objs["Deposit"] = ct.Deposit(
+    objs["Deposit"] = T("Deposit")(
         proof=[bytes([i]) * 32 for i in range(33)], data=objs["DepositData"]
     )
     # ProposerSlashing from two signed headers.
-    objs["ProposerSlashing"] = ct.ProposerSlashing(
+    objs["ProposerSlashing"] = T("ProposerSlashing")(
         signed_header_1=objs["SignedBeaconBlockHeader"],
         signed_header_2=objs["SignedBeaconBlockHeader"],
     )

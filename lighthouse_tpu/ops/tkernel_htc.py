@@ -85,12 +85,12 @@ def _sqrt_ratio_t(u, v, ebits_ref):
     uv15 = tk.fp2_mul_t(uv7, tk.fp2_mul_t(v4, v4))
     t = tk.fp2_mul_t(uv7, _fp2_pow_bits_t(uv15, ebits_ref, SQRT_RATIO_NBITS))
 
-    zu = tk.fp2_mul_t(jnp.broadcast_to(_cpair("SSWU_Z"), u.shape), u)
-    tz = tk.fp2_mul_t(t, jnp.broadcast_to(_cpair("C_Z"), t.shape))
+    zu = tk.fp2_mul_t(_cpair("SSWU_Z"), u)
+    tz = tk.fp2_mul_t(t, _cpair("C_Z"))
     root = jnp.zeros_like(t)
     ok = jnp.zeros(t.shape[-1:], jnp.int32)
     for i in range(4):
-        cand = tk.fp2_mul_t(t, jnp.broadcast_to(_cpair("SQRT_CANDS", i), t.shape))
+        cand = tk.fp2_mul_t(t, _cpair("SQRT_CANDS", i))
         hit = (
             tk.fp2_eq_t(tk.fp2_mul_t(tk.fp2_sqr_t(cand), v), u).astype(jnp.int32)
             & (1 - ok)
@@ -99,7 +99,7 @@ def _sqrt_ratio_t(u, v, ebits_ref):
         ok = ok | hit
     is_sq = ok
     for i in range(4):
-        cand = tk.fp2_mul_t(tz, jnp.broadcast_to(_cpair("SQRT_CANDS", i), t.shape))
+        cand = tk.fp2_mul_t(tz, _cpair("SQRT_CANDS", i))
         hit = (
             tk.fp2_eq_t(tk.fp2_mul_t(tk.fp2_sqr_t(cand), v), zu).astype(jnp.int32)
             & (1 - ok)
@@ -119,14 +119,12 @@ def _sswu_iso_kernel(u_ref, ebits_ref, consts_ref, out_ref):
         shape = u.shape
 
         def c2(name, off=0):
-            return jnp.broadcast_to(_cpair(name, off), shape)
+            return _cpair(name, off)  # [2,48,1], broadcasts inside ops
 
         a = c2("SSWU_A")
         b = c2("SSWU_B")
         z = c2("SSWU_Z")
-        one = jnp.broadcast_to(
-            jnp.stack([tk._c("R"), tk._c("ZERO")]), shape
-        )
+        one = jnp.stack([tk._c("R"), tk._c("ZERO")])  # [2,48,1]
 
         tv1 = tk.fp2_mul_t(z, tk.fp2_sqr_t(u))          # Z u^2
         tv2 = tk.add_t(tk.fp2_sqr_t(tv1), tv1)
@@ -211,8 +209,8 @@ def _sswu_iso_t(u, interpret: bool):
 
 def _psi_t(P):
     return (
-        tk.fp2_mul_t(tk.fp2_conj_t(P[0]), jnp.broadcast_to(_cpair("PSI_CX"), P[0].shape)),
-        tk.fp2_mul_t(tk.fp2_conj_t(P[1]), jnp.broadcast_to(_cpair("PSI_CY"), P[1].shape)),
+        tk.fp2_mul_t(tk.fp2_conj_t(P[0]), _cpair("PSI_CX")),
+        tk.fp2_mul_t(tk.fp2_conj_t(P[1]), _cpair("PSI_CY")),
         tk.fp2_conj_t(P[2]),
     )
 
