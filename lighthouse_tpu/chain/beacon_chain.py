@@ -533,7 +533,6 @@ class BeaconChain:
         except LockTimeout:
             err = AttestationError("pubkey cache lock timeout")
             return [err for _ in attestations]
-        batch_seen: set[tuple[int, int]] = set()
         try:
             for att in attestations:
                 try:
@@ -542,17 +541,8 @@ class BeaconChain:
                         raise AttestationError("unaggregated attestation must set one bit")
                     vi = int(indexed.attesting_indices[0])
                     epoch = int(att.data.target.epoch)
-                    # observed_attesters only records AFTER verification,
-                    # so intra-batch duplicates AND same-epoch
-                    # equivocations (same attester, different vote) must
-                    # be caught batch-locally — matching what the
-                    # sequential path rejects as 'prior seen'.
-                    if (
-                        self.observed_attesters.is_known(epoch, vi)
-                        or (epoch, vi) in batch_seen
-                    ):
+                    if self.observed_attesters.is_known(epoch, vi):
                         raise AttestationError("duplicate attestation (prior seen)")
-                    batch_seen.add((epoch, vi))
                     sig_set = sigs.indexed_attestation_signature_set(
                         self._head.state,
                         self.pubkey_cache.as_getter(),
@@ -567,22 +557,44 @@ class BeaconChain:
             lock_ctx.__exit__(None, None, None)
 
         sets = [c[4] for c in candidates if c[4] is not None]
+        oks = self._bisect_verify(sets)
         results = []
-        if sets and verify_signature_sets(sets, backend=self.backend):
-            batch_ok = True
-        else:
-            batch_ok = len(sets) == 0
+        it = iter(oks)
         for att, indexed, vi, epoch, sig_set, err in candidates:
             if err is not None:
                 results.append(err)
                 continue
-            ok = batch_ok or verify_signature_sets([sig_set], backend=self.backend)
-            if ok:
+            if next(it):
+                # Dedup AFTER verification (exactly like the sequential
+                # path): the first VERIFIED attestation per (epoch,
+                # attester) wins; later intra-batch duplicates or
+                # equivocations are rejected, and an earlier
+                # invalid-signature copy cannot censor a valid one.
+                if self.observed_attesters.is_known(epoch, vi):
+                    results.append(
+                        AttestationError("duplicate attestation (prior seen)")
+                    )
+                    continue
                 self.observed_attesters.observe(epoch, vi)
                 results.append(VerifiedAttestation(att, indexed))
             else:
                 results.append(AttestationError("invalid attestation signature"))
         return results
+
+    def _bisect_verify(self, sets) -> list[bool]:
+        """Poisoning bisection (SURVEY §7.1 hard part #3): one batched
+        device check per subtree, splitting on failure — k poisoned lanes
+        in an n-set batch cost O(k·log(n/k)) verifier calls instead of the
+        reference's n individual re-verifications
+        (attestation_verification/batch.rs falls back to per-set)."""
+        if not sets:
+            return []
+        if verify_signature_sets(sets, backend=self.backend):
+            return [True] * len(sets)
+        if len(sets) == 1:
+            return [False]
+        mid = len(sets) // 2
+        return self._bisect_verify(sets[:mid]) + self._bisect_verify(sets[mid:])
 
     def _gossip_attestation_checks(self, attestation):
         data = attestation.data

@@ -386,8 +386,6 @@ def run_account(args) -> int:
         # version). Prefer the BN's live view of the current epoch.
         current_epoch = args.current_epoch
         if current_epoch is None and args.beacon_node:
-            from .api import BeaconNodeClient
-
             head = BeaconNodeClient(url=args.beacon_node).get_header()
             slot = int(head["data"]["header"]["message"]["slot"])
             current_epoch = slot // spec.preset.SLOTS_PER_EPOCH

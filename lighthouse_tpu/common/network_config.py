@@ -165,14 +165,10 @@ def load_testnet_dir(path: str):
     (eth2_network_config/src/lib.rs)."""
     import os
 
-    cfg: dict = {}
+    import yaml as _yaml
+
     with open(os.path.join(path, "config.yaml")) as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line or ":" not in line:
-                continue
-            k, v = (x.strip() for x in line.split(":", 1))
-            cfg[k] = v
+        cfg = _yaml.safe_load(f) or {}
 
     base = minimal_spec() if cfg.get("PRESET_BASE") == "minimal" else mainnet_spec()
     updates: dict = {"name": cfg.get("CONFIG_NAME", os.path.basename(path))}
@@ -187,7 +183,11 @@ def load_testnet_dir(path: str):
         "GENESIS_FORK_VERSION", "ALTAIR_FORK_VERSION", "BELLATRIX_FORK_VERSION",
     ):
         if key in cfg and hasattr(base, key):
-            updates[key] = _ver(cfg[key])
+            v = cfg[key]
+            # YAML 1.1 reads 0x-literals as ints; quoted values stay str.
+            updates[key] = (
+                v.to_bytes(4, "big") if isinstance(v, int) else _ver(v)
+            )
     spec = dataclasses.replace(base, **updates)
 
     with open(os.path.join(path, "genesis.ssz"), "rb") as f:
@@ -195,8 +195,6 @@ def load_testnet_dir(path: str):
     enrs: list[str] = []
     enr_path = os.path.join(path, "boot_enr.yaml")
     if os.path.exists(enr_path):
-        import yaml as _yaml
-
         with open(enr_path) as f:
             enrs = _yaml.safe_load(f) or []
     return spec, genesis, enrs

@@ -26,6 +26,7 @@ batches is the idiomatic equivalent of N CPU workers.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
@@ -71,6 +72,7 @@ class _Queue:
     maxlen: int
     lifo: bool
     items: deque = field(default_factory=deque)
+    times: deque = field(default_factory=deque)  # arrival order, parallel
     dropped: int = 0
 
     def push(self, event: WorkEvent) -> bool:
@@ -78,17 +80,29 @@ class _Queue:
             if self.lifo:
                 # LIFO keeps the freshest: evict the oldest entry
                 self.items.popleft()
+                self.times.popleft()
                 self.dropped += 1
             else:
                 self.dropped += 1
                 return False
         self.items.append(event)
+        self.times.append(time.monotonic())
         return True
 
     def pop(self) -> WorkEvent | None:
         if not self.items:
             return None
-        return self.items.pop() if self.lifo else self.items.popleft()
+        if self.lifo:
+            self.times.pop()
+            return self.items.pop()
+        self.times.popleft()
+        return self.items.popleft()
+
+    def overdue(self, deadline_ms: float) -> bool:
+        """Has the OLDEST queued entry waited past the deadline?"""
+        return bool(self.times) and (
+            (time.monotonic() - self.times[0]) * 1e3 >= deadline_ms
+        )
 
     def drain(self, limit: int) -> list[WorkEvent]:
         out = []
@@ -148,8 +162,15 @@ BATCHED = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
 class BeaconProcessor:
     """Bounded prioritized queues + batch-coalescing drain loop."""
 
-    def __init__(self, attestation_batch_size: int = 1024):
+    def __init__(self, attestation_batch_size: int = 1024,
+                 batch_deadline_ms: float = 0.0):
         self.attestation_batch_size = attestation_batch_size
+        # Adaptive batch-or-timeout accumulation (SURVEY §7.1 hard part
+        # #3): with a nonzero deadline, a PARTIAL batch is held in its
+        # queue until the oldest entry has waited deadline_ms — the
+        # device prefers big batches, gossip wants bounded latency. 0 =
+        # dispatch immediately (the reference's opportunistic drain).
+        self.batch_deadline_ms = batch_deadline_ms
         self.queues: dict[WorkType, _Queue] = {
             wt: _Queue(maxlen=m, lifo=lifo) for wt, (m, lifo) in QUEUE_SPECS.items()
         }
@@ -186,6 +207,12 @@ class BeaconProcessor:
                 continue
             handler = self.handlers.get(wt)
             if wt in BATCHED:
+                if (
+                    self.batch_deadline_ms > 0
+                    and len(q) < self.attestation_batch_size
+                    and not q.overdue(self.batch_deadline_ms)
+                ):
+                    continue  # keep accumulating toward a full batch
                 batch = q.drain(self.attestation_batch_size)
                 if handler is not None:
                     handler(batch)

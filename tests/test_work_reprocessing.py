@@ -39,14 +39,18 @@ class TestReprocessQueue:
     def test_expiry_after_delay(self):
         proc = BeaconProcessor()
         q = ReprocessQueue(proc)
-        q.queue_unknown_block_attestation(_ev(), b"\x03" * 32, current_slot=5)
+        ev = _ev()
+        q.queue_unknown_block_attestation(ev, b"\x03" * 32, current_slot=5)
         q.tick(5 + QUEUED_ATTESTATION_DELAY_SLOTS)  # still within delay
         assert q.parked() == 1
         q.tick(5 + QUEUED_ATTESTATION_DELAY_SLOTS + 1)
         assert q.parked() == 0
         assert q.stats["expired"] == 1
-        assert proc.pending() == 0  # expired, not requeued
-        # a late import of the block finds nothing
+        # Expired work is RE-QUEUED (reference ReadyWork semantics), marked
+        # so the router won't park it a second time.
+        assert proc.pending() == 1
+        assert ev.reprocessed
+        # a late import of the block finds nothing still parked
         assert q.on_block_imported(b"\x03" * 32) == 0
 
     def test_bounded(self):
