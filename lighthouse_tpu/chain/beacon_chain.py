@@ -581,10 +581,17 @@ class BeaconChain:
                 results.append(AttestationError("invalid attestation signature"))
         return results
 
-    def _bisect_verify(self, sets) -> list[bool]:
+    # Below this subtree size (or past this split depth) a failing batch
+    # verifies per-set: bounds the adversarial all-invalid case to O(n)
+    # work/calls instead of O(n log n), while the common few-poisoned-lanes
+    # case keeps its O(k log n) call count.
+    _BISECT_MAX_DEPTH = 5
+    _BISECT_LINEAR_CUTOFF = 2
+
+    def _bisect_verify(self, sets, depth: int = 0) -> list[bool]:
         """Poisoning bisection (SURVEY §7.1 hard part #3): one batched
         device check per subtree, splitting on failure — k poisoned lanes
-        in an n-set batch cost O(k·log(n/k)) verifier calls instead of the
+        in an n-set batch cost O(k·log n) verifier calls instead of the
         reference's n individual re-verifications
         (attestation_verification/batch.rs falls back to per-set)."""
         if not sets:
@@ -593,8 +600,17 @@ class BeaconChain:
             return [True] * len(sets)
         if len(sets) == 1:
             return [False]
+        if (
+            depth >= self._BISECT_MAX_DEPTH
+            or len(sets) <= self._BISECT_LINEAR_CUTOFF
+        ):
+            return [
+                verify_signature_sets([s], backend=self.backend) for s in sets
+            ]
         mid = len(sets) // 2
-        return self._bisect_verify(sets[:mid]) + self._bisect_verify(sets[mid:])
+        return self._bisect_verify(sets[:mid], depth + 1) + self._bisect_verify(
+            sets[mid:], depth + 1
+        )
 
     def _gossip_attestation_checks(self, attestation):
         data = attestation.data

@@ -170,6 +170,9 @@ class BeaconProcessor:
         # queue until the oldest entry has waited deadline_ms — the
         # device prefers big batches, gossip wants bounded latency. 0 =
         # dispatch immediately (the reference's opportunistic drain).
+        # The deadline FIRES on the next process_* call after expiry, so
+        # the owner must poll periodically (NetworkService.poll on the
+        # node tick does); there is no internal timer.
         self.batch_deadline_ms = batch_deadline_ms
         self.queues: dict[WorkType, _Queue] = {
             wt: _Queue(maxlen=m, lifo=lifo) for wt, (m, lifo) in QUEUE_SPECS.items()

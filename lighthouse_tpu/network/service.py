@@ -26,13 +26,16 @@ class NetworkService:
         hub: InMemoryHub,
         node_id: str,
         attestation_batch_size: int = 1024,
+        batch_deadline_ms: float = 0.0,
         subscribe_all_subnets: bool = True,
     ):
         self.chain = chain
         self.node_id = node_id
         self.peer: Peer = hub.join(node_id)
         self.peer_manager = PeerManager()
-        self.processor = BeaconProcessor(attestation_batch_size)
+        self.processor = BeaconProcessor(
+            attestation_batch_size, batch_deadline_ms=batch_deadline_ms
+        )
         self.sync = SyncManager(
             chain, self.peer, self.peer_manager, self.processor, chain.spec
         )

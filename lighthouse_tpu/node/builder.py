@@ -44,6 +44,10 @@ class ClientConfig:
     validator_monitor_auto: bool = False  # watch all validators
     validator_monitor_indices: tuple = ()  # or specific indices
     attestation_batch_size: int = 1024
+    # >0 holds partial gossip batches until the oldest entry has waited
+    # this long (processor.py batch-or-timeout accumulation); fires on the
+    # node's periodic poll/tick, so keep it a multiple of the poll period.
+    batch_deadline_ms: float = 0.0
     manual_clock: bool = True           # deterministic by default
     extra: dict = field(default_factory=dict)
 
@@ -281,6 +285,7 @@ class ClientBuilder:
             network = NetworkService(
                 chain, self._hub, self._node_id,
                 attestation_batch_size=cfg.attestation_batch_size,
+                batch_deadline_ms=cfg.batch_deadline_ms,
             )
 
         slasher = None

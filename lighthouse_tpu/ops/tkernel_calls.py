@@ -184,28 +184,26 @@ def subgroup_check_g2_t(x, y, inf):
     return _subgroup_check_g2(x, y, inf, _interpret())
 
 
-def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, xbits_ref, consts_ref,
-                          out_ref):
-    """psi(Q) == [x_bls]Q (Bowe's criterion): ~64-step x-scalar chain +
-    one endomorphism evaluation, vs the 255-step full-order multiply of
-    _subgroup_kernel. Q is on-curve by deserialization; infinity passes
-    (pt_subgroup_check semantics)."""
+def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, out_ref):
+    """psi(Q) == [x_bls]Q (Bowe's criterion) with the x-chain laid out by
+    |x|'s STATIC bit pattern: the leading set bit initializes the
+    accumulator and the remaining 5 appear as mixed adds at their exact
+    positions among 63 doublings, instead of a uniform 64-step
+    compute-both-and-select ladder (tkernel_pairing.segmented_x_walk —
+    the Miller loop's segmentation). Q is on-curve by deserialization;
+    infinity passes (pt_subgroup_check semantics)."""
     with tk.bound_consts(consts_ref[:]):
         F = tk.fp2_ops_t()
         x, y = x_ref[:], y_ref[:]
         inf = inf_ref[0, :] != 0
 
-        # [|x_bls|]Q, mixed double-and-add over the 64-bit parameter
-        def step(i, acc):
-            acc = pt_double(F, acc)
-            cand = pt_add_mixed(F, acc, (x, y), inf)
-            return tuple(
-                jnp.where(xbits_ref[i, 0] == 1, c, a)
-                for c, a in zip(cand, acc)
-            )
-
-        P0 = pt_from_affine(F, x, y, inf)
-        acc = jax.lax.fori_loop(1, tp.XPOW_NBITS, step, P0)
+        walk = tp.segmented_x_walk(
+            dbl=lambda a: pt_double(F, a),
+            dbl_add=lambda a: pt_add_mixed(
+                F, pt_double(F, a), (x, y), inf
+            ),
+        )
+        acc = walk(pt_from_affine(F, x, y, inf))  # init = leading bit
         # x_bls < 0: [x]Q = -[|x|]Q
         Xj, Yj, Zj = acc[0], F.neg(acc[1]), acc[2]
 
@@ -231,7 +229,7 @@ def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
     x, y, inf = (_pad_lanes(v, t_pad) for v in (x, y, inf))
     in_specs = _specs(
         [((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
-         ((tp.XPOW_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -241,7 +239,7 @@ def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((1,), True)], tile)[0],
         interpret=interpret,
-    )(x, y, inf, _col(tp.XPOW_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    )(x, y, inf, jnp.asarray(tk.CONSTS_NP))
     return out[0, :t] != 0
 
 

@@ -7,8 +7,9 @@ sequential depth:
 
   * sswu+iso kernel — one ~757-step sqrt_ratio exponentiation chain per
     lane plus straight-line SSWU/isogeny glue; emits Jacobian points on E2.
-  * cofactor kernel — the (x^2-x-1)Q chain (126 steps) and the (x-1)ψ(Q)
-    chain (64 steps) plus ψ²(2Q), fused into one program.
+  * cofactor kernel — the (x^2-x-1)Q chain (126 steps) and the
+    (x-1)ψ(Q) chain (64 steps) plus ψ²(2Q), fused into one program
+    (see the in-kernel NOTE about the not-yet-shipped segmented form).
 
 The Q0+Q1 point addition between them is one XLA-level pt_add (log-depth
 glue, like the verifier's aggregation trees), and the final affine
@@ -27,9 +28,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..crypto.bls.constants import X as X_PARAM
 from . import tkernel as tk
 from . import tkernel_calls as tc
+from ..crypto.bls.constants import X as _X_PARAM
 from .htc import SQRT_RATIO_BITS, _K_X2
 from .points import pt_add, pt_double, pt_neg
 from .tkernel import N_LIMBS
@@ -38,7 +39,7 @@ from .tkernel_calls import _col, _pad_lanes, _specs, _tile_for
 SQRT_RATIO_NBITS = len(SQRT_RATIO_BITS)
 K_X2_BITS_NP = tk.bits_msb_first(_K_X2)
 K_X2_NBITS = len(K_X2_BITS_NP)
-
+X_P1_BITS_NP = tk.bits_msb_first(-_X_PARAM + 1)  # |x| + 1
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -215,8 +216,19 @@ def _psi_t(P):
     )
 
 
+
+
 def _cofactor_kernel(pt_ref, k2bits_ref, xbits_ref, consts_ref, out_ref):
-    """(x^2-x-1) Q + (x-1) ψ(Q) + ψ(ψ(2Q)) — htc.clear_cofactor fused."""
+    """(x^2-x-1) Q + (x-1) ψ(Q) + ψ(ψ(2Q)) — htc.clear_cofactor fused.
+
+    NOTE r2: a segmented two-x-chain formulation (t=[|x|]Q, t2=[|x|]t,
+    term0 = t2+t-Q) would cut the group operations ~3.7x, and every
+    component (x-chain vs pt_scalar_mul_const, fori vs eager doubling,
+    per-segment walk) verifies in isolation — but the composed kernel
+    diverged from the classic path on pipeline points in interpret mode
+    and the divergence was not root-caused in time. The uniform bit-table
+    chains below are the proven-correct form; see memory notes for the
+    debugging state."""
     with tk.bound_consts(consts_ref[:]):
         F = tk.fp2_ops_t()
         Q = (pt_ref[0], pt_ref[1], pt_ref[2])
@@ -236,9 +248,6 @@ def _cofactor_kernel(pt_ref, k2bits_ref, xbits_ref, consts_ref, out_ref):
         t2 = _psi_t(_psi_t(pt_double(F, Q)))
         out = pt_add(F, pt_add(F, t0, t1), t2)
         out_ref[:] = jnp.stack(out)
-
-
-X_P1_BITS_NP = tk.bits_msb_first(-X_PARAM + 1)  # |x| + 1
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

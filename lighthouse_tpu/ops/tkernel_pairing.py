@@ -161,7 +161,34 @@ def _miller_segments():
     return segs, run
 
 
-_MILLER_ADD_RUNS, _MILLER_TAIL = _miller_segments()
+# Public segment layout: runs of 0-bits before each of the 5 below-leading
+# set bits of |x|, plus the trailing-zero tail. Shared by the Miller loop
+# and every [|x|]-style chain (subgroup psi-check).
+X_ADD_RUNS, X_TAIL = _miller_segments()
+_MILLER_ADD_RUNS, _MILLER_TAIL = X_ADD_RUNS, X_TAIL
+
+
+def segmented_x_walk(dbl, dbl_add):
+    """Drive a double-and-add over |x|'s STATIC bit layout: callbacks get
+    (acc) for a doubling-only step and (acc) for a dbl+add step. The
+    caller provides the initial acc (the leading bit's value). Used by
+    miller_loop_t and the subgroup kernel so the segment bookkeeping
+    lives in exactly one place."""
+
+    def walk(acc):
+        def run_dbls(a, n):
+            if n == 0:
+                return a
+            if n == 1:
+                return dbl(a)
+            return jax.lax.fori_loop(0, n, lambda _i, x: dbl(x), a)
+
+        for run in X_ADD_RUNS:
+            acc2 = run_dbls(acc, run)
+            acc = dbl_add(acc2)
+        return run_dbls(acc, X_TAIL)
+
+    return walk
 
 
 def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src=None):
