@@ -581,36 +581,47 @@ class BeaconChain:
                 results.append(AttestationError("invalid attestation signature"))
         return results
 
-    # Below this subtree size (or past this split depth) a failing batch
-    # verifies per-set: bounds the adversarial all-invalid case to O(n)
-    # work/calls instead of O(n log n), while the common few-poisoned-lanes
-    # case keeps its O(k log n) call count.
-    _BISECT_MAX_DEPTH = 5
+    # Below this subtree size a failing batch verifies per-set (a batch
+    # call plus two singles costs more than two singles).
     _BISECT_LINEAR_CUTOFF = 2
+    # Device-work budget multiplier: bisection may process at most
+    # BUDGET*n sets' worth of batched verification before the remaining
+    # failing subtrees degrade to per-set scans. k poisoned lanes cost
+    # ~n*(log2 k + 2) batched work, so 6n covers k <= ~16 with full
+    # O(k log n) call-count bisection; an adversarial all-invalid batch
+    # is bounded at O(n) total work (6n batched + n singles) instead of
+    # the unbudgeted O(n log n).
+    _BISECT_WORK_BUDGET = 6
 
-    def _bisect_verify(self, sets, depth: int = 0) -> list[bool]:
+    def _bisect_verify(self, sets) -> list[bool]:
         """Poisoning bisection (SURVEY §7.1 hard part #3): one batched
         device check per subtree, splitting on failure — k poisoned lanes
         in an n-set batch cost O(k·log n) verifier calls instead of the
         reference's n individual re-verifications
         (attestation_verification/batch.rs falls back to per-set)."""
+        budget = [self._BISECT_WORK_BUDGET * len(sets)]
+        return self._bisect_verify_budgeted(sets, budget)
+
+    def _bisect_verify_budgeted(self, sets, budget) -> list[bool]:
         if not sets:
             return []
+        budget[0] -= len(sets)
         if verify_signature_sets(sets, backend=self.backend):
             return [True] * len(sets)
         if len(sets) == 1:
             return [False]
-        if (
-            depth >= self._BISECT_MAX_DEPTH
-            or len(sets) <= self._BISECT_LINEAR_CUTOFF
-        ):
+        # Failed batch: split while budget remains, else scan per-set.
+        # (The check sits after the batch call, so overshoot is bounded
+        # by one failing call per exhausted subtree — total batched work
+        # stays O(budget).)
+        if budget[0] <= 0 or len(sets) <= self._BISECT_LINEAR_CUTOFF:
             return [
                 verify_signature_sets([s], backend=self.backend) for s in sets
             ]
         mid = len(sets) // 2
-        return self._bisect_verify(sets[:mid], depth + 1) + self._bisect_verify(
-            sets[mid:], depth + 1
-        )
+        return self._bisect_verify_budgeted(
+            sets[:mid], budget
+        ) + self._bisect_verify_budgeted(sets[mid:], budget)
 
     def _gossip_attestation_checks(self, attestation):
         data = attestation.data

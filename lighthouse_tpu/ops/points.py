@@ -39,12 +39,17 @@ class FieldOps:
     """Namespace of batched field ops (trailing-axis polymorphic)."""
 
     def __init__(self, *, mul, sqr, add, sub, neg, double, inv, is_zero, eq,
-                 zero, one, ndim_tail):
+                 zero, one, ndim_tail, canon=None):
         self.mul, self.sqr, self.add, self.sub = mul, sqr, add, sub
         self.neg, self.double, self.inv = neg, double, inv
         self.is_zero, self.eq = is_zero, eq
         self.zero, self.one = zero, one  # host constants, shape = tail dims
         self.ndim_tail = ndim_tail
+        # Full reduction [0,2p) -> [0,p). Group-op schedules differ in
+        # which representative of a value they produce; canonicalizing at
+        # representation boundaries (pt_to_affine) makes equal points
+        # bitwise equal across schedules (fused vs classic parity).
+        self.canon = canon if canon is not None else (lambda a: a)
 
     def select(self, mask, a, b):
         """a where mask else b, broadcasting mask over the field tail dims."""
@@ -59,6 +64,7 @@ FP_OPS = FieldOps(
     neg=limb.neg, double=limb.double, inv=limb.mont_inv,
     is_zero=limb.is_zero, eq=limb.eq,
     zero=limb.ZERO_LIMBS, one=limb.R_LIMBS, ndim_tail=1,
+    canon=limb.canonical,
 )
 
 FP2_OPS = FieldOps(
@@ -66,6 +72,7 @@ FP2_OPS = FieldOps(
     sub=tower.fp2_sub, neg=tower.fp2_neg, double=tower.fp2_double,
     inv=tower.fp2_inv, is_zero=tower.fp2_is_zero, eq=tower.fp2_eq,
     zero=tower.FP2_ZERO, one=tower.FP2_ONE, ndim_tail=2,
+    canon=limb.canonical,  # trailing-limb-axis polymorphic over the 2
 )
 
 
@@ -92,11 +99,19 @@ def pt_from_affine(F, x, y, inf_mask=None):
 
 
 def pt_to_affine(F, P):
-    """Jacobian -> affine (batched inversion); infinity -> (0, 0, True)."""
+    """Jacobian -> affine (batched inversion); infinity -> (0, 0, True).
+
+    Outputs are canonical ([0, p) limbs): affine coordinates are the
+    representation boundary where different group-op schedules must
+    agree bitwise."""
     X, Y, Z = P
     zi = F.inv(Z)          # 0 -> 0, so infinity lanes stay zeroed
     zi2 = F.sqr(zi)
-    return F.mul(X, zi2), F.mul(Y, F.mul(zi, zi2)), F.is_zero(Z)
+    return (
+        F.canon(F.mul(X, zi2)),
+        F.canon(F.mul(Y, F.mul(zi, zi2))),
+        F.is_zero(Z),
+    )
 
 
 def pt_neg(F, P):

@@ -628,6 +628,7 @@ def fp_ops_t() -> TFieldOps:
         neg=neg_t, double=double_t, inv=mont_inv_t,
         is_zero=is_zero_t, eq=eq_t,
         zero=jnp.zeros((N_LIMBS, 1), jnp.int32), one=_c("R"), ndim_tail=2,
+        canon=canonical_t,
     )
 
 
@@ -641,4 +642,5 @@ def fp2_ops_t() -> TFieldOps:
         neg=fp2_neg_t, double=fp2_double_t, inv=fp2_inv_t,
         is_zero=fp2_is_zero_t, eq=fp2_eq_t,
         zero=zero2, one=one2, ndim_tail=3,
+        canon=canonical_t,
     )

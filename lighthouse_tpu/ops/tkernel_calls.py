@@ -259,8 +259,11 @@ def _to_affine_kernel(g2: bool):
             X, Y, Z = pt_ref[0], pt_ref[1], pt_ref[2]
             zi = F.inv(Z)
             zi2 = F.sqr(zi)
-            out_ref[0] = F.mul(X, zi2)
-            out_ref[1] = F.mul(Y, F.mul(zi, zi2))
+            # canonical outputs: affine coordinates are the boundary
+            # where different op schedules must agree bitwise
+            # (points.pt_to_affine contract)
+            out_ref[0] = tk.canonical_t(F.mul(X, zi2))
+            out_ref[1] = tk.canonical_t(F.mul(Y, F.mul(zi, zi2)))
             inf_ref[0, :] = F.is_zero(Z).astype(jnp.int32)
 
     return kernel
@@ -377,10 +380,10 @@ def _easy_exp_kernel(f_ref, pinv_ref, consts_ref, out_ref):
 
 
 def _pow_kernel(xm1: bool):
-    def kernel(f_ref, xbits_ref, consts_ref, out_ref):
+    def kernel(f_ref, consts_ref, out_ref):
         with tk.bound_consts(consts_ref[:], lowmem=True):
             f = f_ref[:]
-            p = tp._cyc_pow_x_t(f, xbits_ref)
+            p = tp._cyc_pow_x_t(f)
             if xm1:  # f^(x-1) = f^x * conj(f)
                 p = tk.fp12_mul_t(p, tk.fp12_conj_t(f))
             out_ref[:] = p
@@ -431,12 +434,9 @@ def _final_exp_t(f, interpret: bool):
     t = f.shape[-1]
     consts = jnp.asarray(tk.CONSTS_NP)
     cs = [((tk.N_CONSTS, N_LIMBS, 1), False)]
-    xb = [((tp.XPOW_NBITS, 1), False)] + cs
-    xbits = _col(tp.XPOW_BITS_NP)
 
     def pow_(g, xm1):
-        return _f12_call(_pow_kernel(xm1), [g], xb, [xbits, consts],
-                         t, interpret)
+        return _f12_call(_pow_kernel(xm1), [g], cs, [consts], t, interpret)
 
     def comb(u, v, mode):
         return _f12_call(_comb_kernel(mode), [u, v], cs, [consts],

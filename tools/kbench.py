@@ -1,0 +1,109 @@
+"""Micro-benchmark individual fused kernels on the current device.
+
+Usage: python tools/kbench.py [S] [name ...]
+
+Names: scalar_g1 scalar_g2 subgroup subgroup_full to_affine_g1
+       to_affine_g2 miller sswu cofactor final_exp
+
+Each kernel is compiled (persistent cache), warmed, then timed over
+REPS=5 with block_until_ready. Inputs are generator-point lanes — timing
+is data-independent (constant-time chains)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache_tpu"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+from lighthouse_tpu.jax_backend import _rand_bits_array
+from lighthouse_tpu.ops import tkernel as tk
+from lighthouse_tpu.ops import tkernel_calls as tc
+from lighthouse_tpu.ops.points import G1_GEN_DEV, G2_GEN_DEV
+
+REPS = int(os.environ.get("KBENCH_REPS", "5"))
+
+
+def timeit(label, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        jax.block_until_ready(fn())
+    dt = (time.perf_counter() - t0) / REPS * 1e3
+    print(f"{label:28s} {dt:9.1f} ms   (first call {compile_s:.1f}s)")
+    sys.stdout.flush()
+
+
+def main():
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    names = sys.argv[2:] or [
+        "scalar_g1", "scalar_g2", "subgroup", "to_affine_g1",
+        "to_affine_g2", "miller", "sswu", "cofactor", "final_exp",
+    ]
+    print(f"device={jax.devices()[0].platform} S={S} reps={REPS}")
+
+    g1x = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[0])[:, None], (48, S))
+    g1y = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[1])[:, None], (48, S))
+    g2x = jnp.broadcast_to(jnp.asarray(G2_GEN_DEV[0])[..., None], (2, 48, S))
+    g2y = jnp.broadcast_to(jnp.asarray(G2_GEN_DEV[1])[..., None], (2, 48, S))
+    inf_row = jnp.zeros((1, S), jnp.int32)
+    bits_t = jnp.transpose(jnp.asarray(_rand_bits_array(S)))
+    jax.block_until_ready((g1x, g1y, g2x, g2y, bits_t))
+
+    jac1 = (g1x, g1y, jnp.broadcast_to(tk._c("R"), (48, S)))
+    jac2 = (g2x, g2y, jnp.broadcast_to(
+        jnp.concatenate([tk._c("R")[None], jnp.zeros((1, 48, 1), jnp.int32)]),
+        (2, 48, S)))
+
+    for name in names:
+        if name == "scalar_g1":
+            timeit("scalar_mul_g1", lambda: tc.scalar_mul_g1_t(g1x, g1y, inf_row, bits_t))
+        elif name == "scalar_g2":
+            timeit("scalar_mul_g2", lambda: tc.scalar_mul_g2_t(g2x, g2y, inf_row, bits_t))
+        elif name == "subgroup":
+            timeit("subgroup_fast (psi)", lambda: tc.subgroup_check_g2_fast_t(g2x, g2y, inf_row))
+        elif name == "subgroup_full":
+            timeit("subgroup_full ([r]Q)", lambda: tc.subgroup_check_g2_t(g2x, g2y, inf_row))
+        elif name == "to_affine_g1":
+            timeit("to_affine_g1", lambda: tc.to_affine_g1_t(jac1))
+        elif name == "to_affine_g2":
+            timeit("to_affine_g2", lambda: tc.to_affine_g2_t(jac2))
+        elif name == "miller":
+            timeit("miller_loop", lambda: tc.miller_loop_kernel_t(
+                (g1x, g1y), inf_row[0] != 0, (g2x, g2y), inf_row[0] != 0))
+        elif name == "sswu":
+            from lighthouse_tpu.ops.tkernel_htc import _interpret, _sswu_iso_t
+            u = g2x  # any Fp2 lanes work as field input
+            timeit("sswu+iso", lambda: _sswu_iso_t(u, _interpret()))
+        elif name == "cofactor":
+            from lighthouse_tpu.ops.tkernel_htc import _cofactor_t, _interpret
+            timeit("cofactor", lambda: _cofactor_t(jac2, _interpret()))
+        elif name == "final_exp":
+            f = jnp.broadcast_to(
+                jnp.zeros((2, 3, 2, 48, 1), jnp.int32).at[0, 0, 0].set(tk._c("R")),
+                (2, 3, 2, 48, min(S, 128)),
+            )
+            timeit("final_exp", lambda: tc.final_exp_kernel_t(f))
+        else:
+            print(f"unknown kernel: {name}")
+
+
+if __name__ == "__main__":
+    main()

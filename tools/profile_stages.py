@@ -1,0 +1,180 @@
+"""Stage-level wall-time profile of the fused verifier + hash path on TPU.
+
+Times each device stage of jax_backend._verify_core_fused and the
+hash-to-G2 pipeline separately (block_until_ready around each), plus the
+host-side assembly costs, at the bench shape S=2048, K=1. Guides kernel
+optimization: run after kernel changes to see which stage moved.
+
+Usage:  python tools/profile_stages.py [S]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache_tpu"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lighthouse_tpu.jax_backend import _rand_bits_array
+from lighthouse_tpu.ops import tkernel as tk
+from lighthouse_tpu.ops import tkernel_calls as tc
+from lighthouse_tpu.ops.points import (
+    FP2_OPS, FP_OPS, g1_to_dev, g2_to_dev, pt_from_affine, pt_tree_sum,
+    pt_tree_sum_axis,
+)
+from lighthouse_tpu.ops.pairing import fp12_tree_prod
+from lighthouse_tpu.utils import next_pow2
+
+
+def timeit(label, fn, reps=3):
+    fn()  # warm / compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps * 1e3
+    print(f"{label:42s} {dt:10.1f} ms")
+    return dt
+
+
+def main():
+    S = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    K = 1
+    print(f"device={jax.devices()[0].platform} S={S} K={K}")
+
+    sks = [SecretKey.from_int(i + 101) for i in range(S)]
+    msgs = [i.to_bytes(32, "big") for i in range(S)]
+    sets = [
+        SignatureSet.single_pubkey(sk.sign(m), sk.public_key(), m)
+        for sk, m in zip(sks, msgs)
+    ]
+
+    # ------------------------------------------------ host assembly costs
+    t0 = time.perf_counter()
+    px, py, pinf = g1_to_dev([s.signing_keys[0].point for s in sets])
+    print(f"{'host g1_to_dev (pubkeys)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    px, py, pinf = px.reshape(S, K, 48), py.reshape(S, K, 48), pinf.reshape(S, K)
+    t0 = time.perf_counter()
+    sx, sy, sinf = g2_to_dev([s.signature.point for s in sets])
+    print(f"{'host g2_to_dev (sigs)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    t0 = time.perf_counter()
+    mpts = [hash_to_g2(m) for m in msgs]
+    print(f"{'host hash_to_g2 python x S':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    mx, my, minf = g2_to_dev(mpts)
+    t0 = time.perf_counter()
+    r_bits = _rand_bits_array(S)
+    print(f"{'host rand bits':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+
+    pk = (jnp.asarray(px), jnp.asarray(py))
+    pinf_d = jnp.asarray(pinf)
+    sig = (jnp.asarray(sx), jnp.asarray(sy))
+    sinf_d = jnp.asarray(sinf)
+    msg = (jnp.asarray(mx), jnp.asarray(my))
+    minf_d = jnp.asarray(minf)
+    bits = jnp.asarray(r_bits)
+    jax.block_until_ready((pk, sig, msg, bits))
+
+    # ------------------------------------------------ device stage timings
+    # pk aggregation tree (K=1: near no-op) + to-affine
+    pk_j = pt_from_affine(FP_OPS, pk[0], pk[1], pinf_d)
+    agg = pt_tree_sum_axis(FP_OPS, pk_j, axis=1, axis_size=K)
+    agg = jax.block_until_ready(agg)
+    agg_t = tuple(tk.batch_to_t(c) for c in agg)
+    agg_t = jax.block_until_ready(agg_t)
+
+    timeit("to_affine_g1 (agg)", lambda: tc.to_affine_g1_t(agg_t))
+    ax, ay, ainf = tc.to_affine_g1_t(agg_t)
+    ainf_row = ainf[None, :].astype(jnp.int32)
+    bits_t = jnp.transpose(bits)
+    sig_t = (tk.batch_to_t(sig[0]), tk.batch_to_t(sig[1]))
+    sig_t = jax.block_until_ready(sig_t)
+    sinf_row = sinf_d[None, :].astype(jnp.int32)
+
+    timeit("scalar_mul_g1 (RLC pk)", lambda: tc.scalar_mul_g1_t(ax, ay, ainf_row, bits_t))
+    rpk = jax.block_until_ready(tc.scalar_mul_g1_t(ax, ay, ainf_row, bits_t))
+    timeit("scalar_mul_g2 (RLC sig)", lambda: tc.scalar_mul_g2_t(sig_t[0], sig_t[1], sinf_row, bits_t))
+    rsig = jax.block_until_ready(tc.scalar_mul_g2_t(sig_t[0], sig_t[1], sinf_row, bits_t))
+    timeit("subgroup_check_g2_fast", lambda: tc.subgroup_check_g2_fast_t(sig_t[0], sig_t[1], sinf_row))
+
+    rsig_c = tuple(tk.batch_from_t(c) for c in rsig)
+    timeit("pt_tree_sum rsig (XLA glue)", lambda: pt_tree_sum(FP2_OPS, rsig_c, S))
+    sig_acc = jax.block_until_ready(pt_tree_sum(FP2_OPS, rsig_c, S))
+    sig_acc_t = tuple(tk.batch_to_t(c[None]) for c in sig_acc)
+    timeit("to_affine_g2 (sig acc, 1 lane)", lambda: tc.to_affine_g2_t(sig_acc_t))
+    timeit("to_affine_g1 (rpk)", lambda: tc.to_affine_g1_t(rpk))
+
+    rx, ry, rinf = jax.block_until_ready(tc.to_affine_g1_t(rpk))
+    sax, say, sainf = jax.block_until_ready(tc.to_affine_g2_t(sig_acc_t))
+    from lighthouse_tpu.ops.limb import neg as limb_neg
+    from lighthouse_tpu.ops.points import G1_GEN_DEV
+    neg_g1 = (G1_GEN_DEV[0][:, None], limb_neg(G1_GEN_DEV[1])[:, None])
+    g1_x = jnp.concatenate([rx, neg_g1[0]], axis=-1)
+    g1_y = jnp.concatenate([ry, neg_g1[1]], axis=-1)
+    g1_inf = jnp.concatenate([rinf, jnp.zeros((1,), bool)])
+    msg_t = (tk.batch_to_t(msg[0]), tk.batch_to_t(msg[1]))
+    g2_x = jnp.concatenate([msg_t[0], sax], axis=-1)
+    g2_y = jnp.concatenate([msg_t[1], say], axis=-1)
+    g2_inf = jnp.concatenate([minf_d, sainf])
+    args = jax.block_until_ready((g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf))
+
+    timeit("miller_loop kernel (S+1 lanes)",
+           lambda: tc.miller_loop_kernel_t((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf))
+    f = jax.block_until_ready(
+        tc.miller_loop_kernel_t((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf))
+
+    from lighthouse_tpu.ops import tower
+    M = next_pow2(S + 1)
+    f_c = tk.batch_from_t(f)
+    pad = M - (S + 1)
+    ones = jnp.broadcast_to(tower.FP12_ONE, (pad, *tower.FP12_ONE.shape))
+    f_cp = jax.block_until_ready(jnp.concatenate([f_c, ones]))
+    timeit("fp12_tree_prod (XLA glue)", lambda: fp12_tree_prod(f_cp, M))
+    f1 = jax.block_until_ready(fp12_tree_prod(f_cp, M))
+    timeit("final_exp kernel (1 lane)",
+           lambda: tc.final_exp_kernel_t(tk.batch_to_t(f1[None])))
+
+    # ------------------------------------------------ hash path stages
+    from lighthouse_tpu.ops.htc import DST, hash_to_field_dev
+    from lighthouse_tpu.ops.tkernel_htc import (
+        _cofactor_t, _interpret, _map_to_g2_fused, _sswu_iso_t,
+    )
+
+    t0 = time.perf_counter()
+    u = jnp.asarray(hash_to_field_dev(msgs, DST))
+    u = jax.block_until_ready(u)
+    print(f"{'host hash_to_field (SHA)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+
+    n = u.shape[0]
+    flat = jnp.moveaxis(u, 1, 0).reshape(2 * n, 2, 48)
+    ut = jax.block_until_ready(tk.batch_to_t(flat))
+    timeit("sswu+iso kernel (2S lanes)", lambda: _sswu_iso_t(ut, _interpret()))
+    X, Y, Z = jax.block_until_ready(_sswu_iso_t(ut, _interpret()))
+    F2 = tk.fp2_ops_t()
+    from lighthouse_tpu.ops.points import pt_add
+    Q = jax.block_until_ready(pt_add(
+        F2, (X[..., :n], Y[..., :n], Z[..., :n]),
+        (X[..., n:], Y[..., n:], Z[..., n:])))
+    timeit("cofactor kernel (S lanes)", lambda: _cofactor_t(Q, _interpret()))
+    Qc = jax.block_until_ready(_cofactor_t(Q, _interpret()))
+    timeit("to_affine_g2 (hash out)", lambda: tc.to_affine_g2_t(Qc))
+    timeit("hash full _map_to_g2_fused", lambda: _map_to_g2_fused(u))
+
+
+if __name__ == "__main__":
+    main()
