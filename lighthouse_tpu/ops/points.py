@@ -39,12 +39,13 @@ class FieldOps:
     """Namespace of batched field ops (trailing-axis polymorphic)."""
 
     def __init__(self, *, mul, sqr, add, sub, neg, double, inv, is_zero, eq,
-                 zero, one, ndim_tail, canon=None):
+                 zero, one, ndim_tail, canon=None, stack_muln=True):
         self.mul, self.sqr, self.add, self.sub = mul, sqr, add, sub
         self.neg, self.double, self.inv = neg, double, inv
         self.is_zero, self.eq = is_zero, eq
         self.zero, self.one = zero, one  # host constants, shape = tail dims
         self.ndim_tail = ndim_tail
+        self.stack_muln = stack_muln
         # Full reduction [0,2p) -> [0,p). Group-op schedules differ in
         # which representative of a value they produce; canonicalizing at
         # representation boundaries (pt_to_affine) makes equal points
@@ -57,6 +58,33 @@ class FieldOps:
 
     def triple(self, a):
         return self.add(self.double(a), a)
+
+    def muln(self, *pairs):
+        """Independent products at one dependency level.
+
+        Stacked into ONE multiplication when the namespace was built
+        with ``stack_muln=True``: the Montgomery engine's sequential
+        limb schedule then runs once for all k products. Measured on
+        v5e this pays only at Fp width (scalar_mul_g1 306→217 ms at
+        S=2048) — at Fp2 width the engine is already bandwidth-bound,
+        so wider stacks cost more data movement than they save in issue
+        overhead (scalar_mul_g2 regressed 406→548 ms) and Fp2
+        namespaces loop instead. Either way the group-law schedules
+        below stay laid out by dependency level, which is also what a
+        future engine with cheaper wide rows would want."""
+        if not self.stack_muln:
+            # object identity marks squarings (schedules pass (v, v)),
+            # which keeps the cheaper dedicated sqr formula in play
+            return tuple(
+                self.sqr(a) if a is b else self.mul(a, b) for a, b in pairs
+            )
+        shape = pairs[0][0].shape
+        for a, b in pairs:
+            shape = jnp.broadcast_shapes(shape, a.shape, b.shape)
+        A = jnp.stack([jnp.broadcast_to(a, shape) for a, _ in pairs])
+        B = jnp.stack([jnp.broadcast_to(b, shape) for _, b in pairs])
+        out = self.mul(A, B)
+        return tuple(out[i] for i in range(len(pairs)))
 
 
 FP_OPS = FieldOps(
@@ -73,6 +101,7 @@ FP2_OPS = FieldOps(
     inv=tower.fp2_inv, is_zero=tower.fp2_is_zero, eq=tower.fp2_eq,
     zero=tower.FP2_ZERO, one=tower.FP2_ONE, ndim_tail=2,
     canon=limb.canonical,  # trailing-limb-axis polymorphic over the 2
+    stack_muln=False,  # Fp2-width stacking measured slower (muln note)
 )
 
 
@@ -122,17 +151,20 @@ def pt_neg(F, P):
 
 
 def pt_double(F, P):
-    """Jacobian doubling (classic 5S+2M schedule); maps infinity->infinity."""
+    """Jacobian doubling (classic 5S+2M values); maps infinity->infinity.
+
+    Scheduled as 4 dependency levels of products (muln):
+    {X², Y², Y·Z} → {B², (X+B)²} → E² → E·(D-X3)."""
     X, Y, Z = P
-    A = F.sqr(X)
-    B = F.sqr(Y)
-    C = F.sqr(B)
-    D = F.double(F.sub(F.sub(F.sqr(F.add(X, B)), A), C))
+    A, B, Zh = F.muln((X, X), (Y, Y), (Y, Z))
+    XB = F.add(X, B)
+    C, S = F.muln((B, B), (XB, XB))
+    D = F.double(F.sub(F.sub(S, A), C))
     E = F.triple(A)
     Fq = F.sqr(E)
     X3 = F.sub(Fq, F.double(D))
     Y3 = F.sub(F.mul(E, F.sub(D, X3)), F.double(F.double(F.double(C))))
-    Z3 = F.double(F.mul(Y, Z))
+    Z3 = F.double(Zh)
     return (X3, Y3, Z3)
 
 
@@ -144,20 +176,23 @@ def pt_add(F, P, Q):
     """
     X1, Y1, Z1 = P
     X2, Y2, Z2 = Q
-    Z1Z1 = F.sqr(Z1)
-    Z2Z2 = F.sqr(Z2)
-    U1 = F.mul(X1, Z2Z2)
-    U2 = F.mul(X2, Z1Z1)
-    S1 = F.mul(Y1, F.mul(Z2, Z2Z2))
-    S2 = F.mul(Y2, F.mul(Z1, Z1Z1))
+    # 6 dependency levels of products (F.muln).
+    Z1Z1, Z2Z2 = F.muln((Z1, Z1), (Z2, Z2))
+    U1, U2, T1, T2 = F.muln(
+        (X1, Z2Z2), (X2, Z1Z1), (Z2, Z2Z2), (Z1, Z1Z1)
+    )
+    S1, S2 = F.muln((Y1, T1), (Y2, T2))
     H = F.sub(U2, U1)
     r = F.double(F.sub(S2, S1))
-    I = F.sqr(F.double(H))
-    J = F.mul(H, I)
-    V = F.mul(U1, I)
-    X3 = F.sub(F.sub(F.sqr(r), J), F.double(V))
-    Y3 = F.sub(F.mul(r, F.sub(V, X3)), F.double(F.mul(S1, J)))
-    Z3 = F.mul(F.sub(F.sub(F.sqr(F.add(Z1, Z2)), Z1Z1), Z2Z2), H)
+    H2 = F.double(H)
+    Z12 = F.add(Z1, Z2)
+    I, rr, ZS = F.muln((H2, H2), (r, r), (Z12, Z12))
+    J, V, Z3 = F.muln(
+        (H, I), (U1, I), (F.sub(F.sub(ZS, Z1Z1), Z2Z2), H)
+    )
+    X3 = F.sub(F.sub(rr, J), F.double(V))
+    Y3a, Y3b = F.muln((r, F.sub(V, X3)), (S1, J))
+    Y3 = F.sub(Y3a, F.double(Y3b))
 
     p_inf = F.is_zero(Z1)
     q_inf = F.is_zero(Z2)
@@ -181,17 +216,20 @@ def pt_add_mixed(F, P, Qaff, q_inf):
     """
     X1, Y1, Z1 = P
     X2, Y2 = Qaff
+    # 6 dependency levels of products (F.muln).
     Z1Z1 = F.sqr(Z1)
-    U2 = F.mul(X2, Z1Z1)
-    S2 = F.mul(Y2, F.mul(Z1, Z1Z1))
+    U2, T = F.muln((X2, Z1Z1), (Z1, Z1Z1))
+    S2 = F.mul(Y2, T)
     H = F.sub(U2, X1)
     r = F.double(F.sub(S2, Y1))
-    I = F.sqr(F.double(H))
-    J = F.mul(H, I)
-    V = F.mul(X1, I)
-    X3 = F.sub(F.sub(F.sqr(r), J), F.double(V))
-    Y3 = F.sub(F.mul(r, F.sub(V, X3)), F.double(F.mul(Y1, J)))
-    Z3 = F.sub(F.sub(F.sqr(F.add(Z1, H)), Z1Z1), F.sqr(H))  # = 2 Z1 H
+    H2 = F.double(H)
+    Z1H = F.add(Z1, H)
+    I, HH, ZS, rr = F.muln((H2, H2), (H, H), (Z1H, Z1H), (r, r))
+    J, V = F.muln((H, I), (X1, I))
+    X3 = F.sub(F.sub(rr, J), F.double(V))
+    Y3a, Y3b = F.muln((r, F.sub(V, X3)), (Y1, J))
+    Y3 = F.sub(Y3a, F.double(Y3b))
+    Z3 = F.sub(F.sub(ZS, Z1Z1), HH)  # = 2 Z1 H
 
     p_inf = F.is_zero(Z1)
     same_x = F.is_zero(H)

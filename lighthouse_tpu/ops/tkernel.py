@@ -446,6 +446,10 @@ def fp6_mul_t(a, b):
         (add_t(a0, a2), add_t(b0, b2)),
     ]
     if _lowmem():
+        # In-kernel: loop the six products. Stacking them (18 mont rows)
+        # measured SLOWER on v5e — the transposed Montgomery engine is
+        # bandwidth-bound at fp2 width, so wider rows cost more data
+        # movement than they save in issue overhead (points.muln note).
         t0, t1, t2, s12, s01, s02 = (fp2_mul_t(x, y) for x, y in pairs)
     else:
         t = fp2_mul_t(
@@ -643,4 +647,5 @@ def fp2_ops_t() -> TFieldOps:
         is_zero=fp2_is_zero_t, eq=fp2_eq_t,
         zero=zero2, one=one2, ndim_tail=3,
         canon=canonical_t,
+        stack_muln=False,  # Fp2 mont rows are bandwidth-bound (see muln)
     )

@@ -36,13 +36,24 @@ _X_ABS = -X
 # Miller bits: below the leading bit, MSB first (pairing.py _X_BITS).
 MILLER_BITS_NP = np.asarray([int(b) for b in bin(_X_ABS)[3:]], np.int32)
 MILLER_NBITS = len(MILLER_BITS_NP)
-# x-power bits: full, MSB first (leading bit consumes the base).
-XPOW_BITS_NP = tk.bits_msb_first(_X_ABS)
-XPOW_NBITS = len(XPOW_BITS_NP)
 
 
 def _stk(xs, axis):
     return jnp.stack(xs, axis=axis)
+
+
+def _muln2(*pairs):
+    """Independent Fp2 products at one dependency level, looped.
+
+    Stacking these into one Karatsuba call measured SLOWER on v5e (the
+    transposed Montgomery engine is bandwidth-bound at fp2 width —
+    points.FieldOps.muln note); the dependency-level grouping is kept
+    because it documents the schedule and is what a cheaper-wide-rows
+    engine would stack. Object identity marks squarings (pairs pass
+    (v, v)), keeping the dedicated 2-row sqr formula in play."""
+    return tuple(
+        fp2_sqr_t(a) if a is b else fp2_mul_t(a, b) for a, b in pairs
+    )
 
 
 def _embed_line(A, B, C, xp, yp):
@@ -57,86 +68,94 @@ def _f6c(a, i):
     return a[..., i, :, :, :]
 
 
-def _mul_by_01(x, a, b):
-    """fp6 x * (a + b v): 5 fp2 muls (vs 6 dense)."""
-    x0, x1, x2 = _f6c(x, 0), _f6c(x, 1), _f6c(x, 2)
-    m0 = fp2_mul_t(x0, a)
-    m1 = fp2_mul_t(x1, b)
-    mx = fp2_sub_t(
-        fp2_sub_t(fp2_mul_t(add_t(x0, x1), add_t(a, b)), m0), m1
-    )
-    c0 = add_t(m0, tk.fp2_mul_by_xi_t(fp2_mul_t(x2, b)))
-    c1 = mx
-    c2 = add_t(m1, fp2_mul_t(x2, a))
-    return _stk([c0, c1, c2], -4)
-
-
-def _mul_by_1(x, c):
-    """fp6 x * (c v): 3 fp2 muls."""
-    x0, x1, x2 = _f6c(x, 0), _f6c(x, 1), _f6c(x, 2)
-    return _stk(
-        [tk.fp2_mul_by_xi_t(fp2_mul_t(x2, c)), fp2_mul_t(x0, c),
-         fp2_mul_t(x1, c)],
-        -4,
-    )
-
-
 def _mul_line_sparse(f, line, xp, yp):
     """f * line with the line kept sparse: the embedded element has only
     slots (c0.c0, c0.c1, c1.c1) = (A, B*xp, C*yp) non-zero, so the
     Karatsuba fp12 product needs 13 fp2 muls instead of the dense 18 —
     and skips all the multiply-by-zero Montgomery work the dense embed
-    pays (blst calls this mul_by_xy00z0; VERDICT r1 item 4)."""
+    pays (blst calls this mul_by_xy00z0; VERDICT r1 item 4).
+
+    All 13 Fp2 products are mutually independent once (B·xp, C·yp) are
+    known — laid out flat at that one dependency level (_muln2), with
+    the two line-scalings as one stacked fp-width multiplication."""
     A, B, C = line
-    bxp = fp2_mul_fp_t(B, xp)
-    cyp = fp2_mul_fp_t(C, yp)
+    bc = fp2_mul_fp_t(jnp.stack([B, C]), jnp.stack([xp, yp]))
+    bxp, cyp = bc[0], bc[1]
+
     f0, f1 = f[..., 0, :, :, :, :], f[..., 1, :, :, :, :]
-    t0 = _mul_by_01(f0, A, bxp)                 # f0 * l0
-    t1 = _mul_by_1(f1, cyp)                     # f1 * l1
+    f00, f01c, f02 = _f6c(f0, 0), _f6c(f0, 1), _f6c(f0, 2)
+    g0, g1, g2 = _f6c(f1, 0), _f6c(f1, 1), _f6c(f1, 2)
+    fs = add_t(f0, f1)
+    s0, s1, s2 = _f6c(fs, 0), _f6c(fs, 1), _f6c(fs, 2)
+    Bc = add_t(bxp, cyp)
+
+    (m0, m1, mx, mu, mv,
+     w2, w0, w1,
+     n0, n1, nx, nu, nv) = _muln2(
+        (f00, A), (f01c, bxp), (add_t(f00, f01c), add_t(A, bxp)),
+        (f02, bxp), (f02, A),
+        (g2, cyp), (g0, cyp), (g1, cyp),
+        (s0, A), (s1, Bc), (add_t(s0, s1), add_t(A, Bc)),
+        (s2, Bc), (s2, A),
+    )
+    # t0 = f0 * (A + bxp v)      (_mul_by_01 recombination)
+    t0 = _stk([add_t(m0, tk.fp2_mul_by_xi_t(mu)),
+               fp2_sub_t(fp2_sub_t(mx, m0), m1),
+               add_t(m1, mv)], -4)
+    # t1 = f1 * (cyp v)          (_mul_by_1 recombination)
+    t1 = _stk([tk.fp2_mul_by_xi_t(w2), w0, w1], -4)
+    # (f0+f1) * (A + (bxp+cyp) v)
+    ts = _stk([add_t(n0, tk.fp2_mul_by_xi_t(nu)),
+               fp2_sub_t(fp2_sub_t(nx, n0), n1),
+               add_t(n1, nv)], -4)
     c0 = add_t(t0, tk.fp6_mul_by_v_t(t1))
-    f01 = add_t(f0, f1)
-    c1 = fp2_sub_t(fp2_sub_t(_mul_by_01(f01, A, add_t(bxp, cyp)), t0), t1)
+    c1 = fp2_sub_t(fp2_sub_t(ts, t0), t1)
     return _stk([c0, c1], -5)
 
 
 def _dbl_step(T):
-    """Double T + line through T scaled by 2YZ^3 (pairing.py _dbl_step)."""
+    """Double T + line through T scaled by 2YZ^3 (pairing.py _dbl_step).
+
+    4 dependency levels of Fp2 products (_muln2):
+    {X², Y², Y·Z, Z²} → {B², (X+B)²} → {E², E·X, E·Z²} → {E·(D-X3), Z3·Z²}."""
     Xc, Yc, Zc = T
-    A_ = fp2_sqr_t(Xc)
-    B_ = fp2_sqr_t(Yc)
-    C_ = fp2_sqr_t(B_)
-    D_ = fp2_double_t(fp2_sub_t(fp2_sub_t(fp2_sqr_t(add_t(Xc, B_)), A_), C_))
+    A_, B_, Zh, Z_sq = _muln2((Xc, Xc), (Yc, Yc), (Yc, Zc), (Zc, Zc))
+    XB = add_t(Xc, B_)
+    C_, S_ = _muln2((B_, B_), (XB, XB))
+    D_ = fp2_double_t(fp2_sub_t(fp2_sub_t(S_, A_), C_))
     E_ = fp2_triple_t(A_)
-    F_ = fp2_sqr_t(E_)
+    F_, EX, EZ = _muln2((E_, E_), (E_, Xc), (E_, Z_sq))
     X3 = fp2_sub_t(F_, fp2_double_t(D_))
-    Y3 = fp2_sub_t(
-        fp2_mul_t(E_, fp2_sub_t(D_, X3)),
-        fp2_double_t(fp2_double_t(fp2_double_t(C_))),
-    )
-    Z3 = fp2_double_t(fp2_mul_t(Yc, Zc))
-    Z_sq = fp2_sqr_t(Zc)
-    lA = fp2_sub_t(fp2_mul_t(E_, Xc), fp2_double_t(B_))
-    lB = fp2_neg_t(fp2_mul_t(E_, Z_sq))
-    lC = fp2_mul_t(Z3, Z_sq)
+    Z3 = fp2_double_t(Zh)
+    Y3a, lC = _muln2((E_, fp2_sub_t(D_, X3)), (Z3, Z_sq))
+    Y3 = fp2_sub_t(Y3a, fp2_double_t(fp2_double_t(fp2_double_t(C_))))
+    lA = fp2_sub_t(EX, fp2_double_t(B_))
+    lB = fp2_neg_t(EZ)
     return (X3, Y3, Z3), (lA, lB, lC)
 
 
 def _add_step(T, Qaff):
-    """T + Q (Q affine) + line scaled by 2ZH (pairing.py _add_step)."""
+    """T + Q (Q affine) + line scaled by 2ZH (pairing.py _add_step).
+
+    6 dependency levels of Fp2 products (_muln2)."""
     X1, Y1, Z1 = T
     xq, yq = Qaff
     Z1Z1 = fp2_sqr_t(Z1)
-    U2 = fp2_mul_t(xq, Z1Z1)
-    S2 = fp2_mul_t(yq, fp2_mul_t(Z1, Z1Z1))
+    U2, Tz = _muln2((xq, Z1Z1), (Z1, Z1Z1))
+    S2 = fp2_mul_t(yq, Tz)
     H = fp2_sub_t(U2, X1)
     r = fp2_double_t(fp2_sub_t(S2, Y1))
-    I = fp2_sqr_t(fp2_double_t(H))
-    J = fp2_mul_t(H, I)
-    V = fp2_mul_t(X1, I)
-    X3 = fp2_sub_t(fp2_sub_t(fp2_sqr_t(r), J), fp2_double_t(V))
-    Y3 = fp2_sub_t(fp2_mul_t(r, fp2_sub_t(V, X3)), fp2_double_t(fp2_mul_t(Y1, J)))
-    Z3 = fp2_sub_t(fp2_sub_t(fp2_sqr_t(add_t(Z1, H)), Z1Z1), fp2_sqr_t(H))
-    lA = fp2_sub_t(fp2_mul_t(r, xq), fp2_mul_t(Z3, yq))
+    H2 = fp2_double_t(H)
+    Z1H = add_t(Z1, H)
+    I, HH, ZS, rr = _muln2((H2, H2), (H, H), (Z1H, Z1H), (r, r))
+    J, V = _muln2((H, I), (X1, I))
+    X3 = fp2_sub_t(fp2_sub_t(rr, J), fp2_double_t(V))
+    Z3 = fp2_sub_t(fp2_sub_t(ZS, Z1Z1), HH)
+    Y3a, Y3b, lA1, lA2 = _muln2(
+        (r, fp2_sub_t(V, X3)), (Y1, J), (r, xq), (Z3, yq)
+    )
+    Y3 = fp2_sub_t(Y3a, fp2_double_t(Y3b))
+    lA = fp2_sub_t(lA1, lA2)
     lB = fp2_neg_t(r)
     lC = Z3
     return (X3, Y3, Z3), (lA, lB, lC)
@@ -221,14 +240,13 @@ def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src=None):
     return jnp.where(trivial, fp12_one_t(xp), f)
 
 
-def _cyc_pow_x_t(f, bit_src=None):
+def _cyc_pow_x_t(f):
     """f^x (x negative BLS parameter), cyclotomic (pairing._cyc_pow_x).
 
     Laid out by |x|'s static bit pattern (segmented_x_walk): 63 squarings
     with the 5 below-leading multiplications inlined at their exact
     positions, instead of a uniform 64-step square-multiply-select ladder
-    that computes and discards a dense fp12_mul on the 58 zero bits.
-    ``bit_src`` is accepted for signature compatibility and ignored."""
+    that computes and discards a dense fp12_mul on the 58 zero bits."""
     walk = segmented_x_walk(
         dbl=fp12_sqr_t,
         dbl_add=lambda a: fp12_mul_t(fp12_sqr_t(a), f),

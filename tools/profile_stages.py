@@ -43,7 +43,7 @@ from lighthouse_tpu.utils import next_pow2
 
 
 def timeit(label, fn, reps=3):
-    fn()  # warm / compile
+    jax.block_until_ready(fn())  # warm / compile, synchronized
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn()
