@@ -5,9 +5,11 @@ leaves) instead of O(n)).
 ``TreeHashCache`` maintains the full merkle layer structure over a
 list's leaf chunks; ``update`` diffs the new leaves against the cached
 ones and recomputes only the paths above changed leaves.
-``StateRootCache`` applies it to a BeaconState's big lists (validators,
-balances, inactivity_scores) — the dominant hashing cost at scale — and
-defers every other field to the plain hasher.
+``StateRootCache`` applies it to EVERY list/vector field of a
+BeaconState (with per-element memos for composite element types) and
+combines the cached field roots through the container hasher; scalar
+fields go to the plain hasher. Contract-tested against plain
+``hash_tree_root`` for each field and the whole state.
 """
 
 from __future__ import annotations

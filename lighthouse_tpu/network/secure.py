@@ -1,0 +1,320 @@
+"""Transport encryption: X25519 + ChaCha20-Poly1305 AEAD under a
+Noise-XX-style handshake.
+
+The reference encrypts every libp2p stream with the Noise protocol
+(`lighthouse_network/src/service.rs:53-120` — noise handshake, then
+AEAD frames). This module is the capability analog for the socket
+transport (wire compatibility with libp2p-noise is NOT a goal):
+
+  * X25519 Diffie-Hellman per RFC 7748 (pure-integer Montgomery ladder;
+    handshakes are rare, performance is irrelevant there).
+  * ChaCha20-Poly1305 AEAD per RFC 8439 — ChaCha20 block function
+    vectorized over blocks with numpy uint32 lanes, Poly1305 as a
+    big-int Horner loop. Both pinned to the RFC test vectors
+    (tests/test_secure.py — external anchors, not self-generated).
+  * An XX-pattern handshake (transcript hashing + HKDF chaining like
+    Noise): ephemeral exchange, then each side's STATIC X25519 key is
+    sent encrypted and authenticated via DH mixes, so both ends learn
+    and verify the remote identity key. The caller may pin the expected
+    remote static (from a signed discovery record) to prevent MITM.
+
+Frame format after the handshake (replaces the plaintext length-prefix
+frames): 4-byte big-endian ciphertext length || ciphertext, where
+ciphertext = ChaCha20-Poly1305(key_dir, nonce=LE64(counter), ad=b"",
+plaintext-frame). Each direction has its own key and counter; nonce
+reuse is impossible by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+
+import numpy as np
+
+# ------------------------------------------------------------- X25519
+
+P25519 = 2**255 - 19
+_A24 = 121665
+
+
+def _decode_scalar(k: bytes) -> int:
+    b = bytearray(k)
+    b[0] &= 248
+    b[31] &= 127
+    b[31] |= 64
+    return int.from_bytes(bytes(b), "little")
+
+
+def _decode_u(u: bytes) -> int:
+    b = bytearray(u)
+    b[31] &= 127
+    return int.from_bytes(bytes(b), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """RFC 7748 §5 scalar multiplication (constant-time irrelevant here:
+    Python bigints aren't, and this guards transport privacy, not
+    long-term signing keys; noted in PARITY.md)."""
+    k_int = _decode_scalar(k)
+    x1 = _decode_u(u)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        k_t = (k_int >> t) & 1
+        if swap ^ k_t:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        A = (x2 + z2) % P25519
+        AA = A * A % P25519
+        B = (x2 - z2) % P25519
+        BB = B * B % P25519
+        E = (AA - BB) % P25519
+        C = (x3 + z3) % P25519
+        D = (x3 - z3) % P25519
+        DA = D * A % P25519
+        CB = C * B % P25519
+        x3 = (DA + CB) % P25519
+        x3 = x3 * x3 % P25519
+        z3 = (DA - CB) % P25519
+        z3 = x1 * (z3 * z3 % P25519) % P25519
+        x2 = AA * BB % P25519
+        z2 = E * (AA + _A24 * E) % P25519
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, P25519 - 2, P25519) % P25519
+    return out.to_bytes(32, "little")
+
+
+X25519_BASEPOINT = (9).to_bytes(32, "little")
+
+
+def x25519_keypair(sk: bytes | None = None) -> tuple[bytes, bytes]:
+    sk = sk if sk is not None else os.urandom(32)
+    return sk, x25519(sk, X25519_BASEPOINT)
+
+
+# ------------------------------------------------- ChaCha20 (RFC 8439)
+
+_SIGMA = np.frombuffer(b"expand 32-byte k", dtype="<u4").copy()
+
+
+def _rotl(x, n):
+    return ((x << np.uint32(n)) | (x >> np.uint32(32 - n))).astype(np.uint32)
+
+
+def _quarter(s, a, b, c, d):
+    s[a] += s[b]; s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] += s[d]; s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] += s[b]; s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] += s[d]; s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+def chacha20_stream(key: bytes, nonce: bytes, counter: int, n: int) -> bytes:
+    """n bytes of ChaCha20 keystream; block function vectorized over all
+    needed blocks at once (numpy uint32 lanes)."""
+    nblocks = -(-n // 64)
+    key_w = np.frombuffer(key, dtype="<u4")
+    nonce_w = np.frombuffer(nonce, dtype="<u4")
+    state = np.zeros((16, nblocks), np.uint32)
+    state[0:4] = _SIGMA[:, None]
+    state[4:12] = key_w[:, None]
+    state[12] = (counter + np.arange(nblocks)).astype(np.uint32)
+    state[13:16] = nonce_w[:, None]
+    w = state.copy()
+    old = np.seterr(over="ignore")
+    try:
+        for _ in range(10):
+            _quarter(w, 0, 4, 8, 12)
+            _quarter(w, 1, 5, 9, 13)
+            _quarter(w, 2, 6, 10, 14)
+            _quarter(w, 3, 7, 11, 15)
+            _quarter(w, 0, 5, 10, 15)
+            _quarter(w, 1, 6, 11, 12)
+            _quarter(w, 2, 7, 8, 13)
+            _quarter(w, 3, 4, 9, 14)
+        w += state
+    finally:
+        np.seterr(**old)
+    return w.T.astype("<u4").tobytes()[:n]
+
+
+def _xor(data: bytes, stream: bytes) -> bytes:
+    a = np.frombuffer(data, np.uint8)
+    b = np.frombuffer(stream[: len(data)], np.uint8)
+    return (a ^ b).tobytes()
+
+
+_P1305 = (1 << 130) - 5
+
+
+def poly1305(key32: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:], "little")
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i : i + 16]
+        n = int.from_bytes(block, "little") + (1 << (8 * len(block)))
+        acc = (acc + n) * r % _P1305
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+def aead_encrypt(key: bytes, nonce: bytes, plaintext: bytes,
+                 ad: bytes = b"") -> bytes:
+    """RFC 8439 §2.8 AEAD; returns ciphertext || 16-byte tag."""
+    otk = chacha20_stream(key, nonce, 0, 32)
+    ct = _xor(plaintext, chacha20_stream(key, nonce, 1, len(plaintext)))
+    mac_data = (
+        ad + _pad16(ad) + ct + _pad16(ct)
+        + struct.pack("<QQ", len(ad), len(ct))
+    )
+    return ct + poly1305(otk, mac_data)
+
+
+def aead_decrypt(key: bytes, nonce: bytes, ct_tag: bytes,
+                 ad: bytes = b"") -> bytes:
+    """Raises ValueError on authentication failure."""
+    if len(ct_tag) < 16:
+        raise ValueError("ciphertext too short")
+    ct, tag = ct_tag[:-16], ct_tag[-16:]
+    otk = chacha20_stream(key, nonce, 0, 32)
+    mac_data = (
+        ad + _pad16(ad) + ct + _pad16(ct)
+        + struct.pack("<QQ", len(ad), len(ct))
+    )
+    if not hmac.compare_digest(poly1305(otk, mac_data), tag):
+        raise ValueError("AEAD tag mismatch")
+    return _xor(ct, chacha20_stream(key, nonce, 1, len(ct)))
+
+
+# ---------------------------------------------------- handshake (XX)
+
+_PROTO = b"lighthouse-tpu-xx-x25519-chacha20poly1305-sha256"
+
+
+def _hkdf2(ck: bytes, ikm: bytes) -> tuple[bytes, bytes]:
+    prk = hmac.new(ck, ikm, hashlib.sha256).digest()
+    t1 = hmac.new(prk, b"\x01", hashlib.sha256).digest()
+    t2 = hmac.new(prk, t1 + b"\x02", hashlib.sha256).digest()
+    return t1, t2
+
+
+class _Symmetric:
+    def __init__(self):
+        self.h = hashlib.sha256(_PROTO).digest()
+        self.ck = self.h
+        self.k: bytes | None = None
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = hashlib.sha256(self.h + data).digest()
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, self.k = _hkdf2(self.ck, ikm)
+
+    def enc(self, pt: bytes) -> bytes:
+        assert self.k is not None
+        ct = aead_encrypt(self.k, b"\x00" * 12, pt, ad=self.h)
+        self.mix_hash(ct)
+        return ct
+
+    def dec(self, ct: bytes) -> bytes:
+        assert self.k is not None
+        pt = aead_decrypt(self.k, b"\x00" * 12, ct, ad=self.h)
+        self.mix_hash(ct)
+        return pt
+
+
+class CipherState:
+    """One direction of the transport: key + monotonically increasing
+    64-bit nonce counter (nonce reuse structurally impossible)."""
+
+    def __init__(self, key: bytes):
+        self.key = key
+        self.n = 0
+
+    def _nonce(self) -> bytes:
+        n = struct.pack("<Q", self.n)
+        self.n += 1
+        return b"\x00" * 4 + n
+
+    def encrypt(self, pt: bytes) -> bytes:
+        return aead_encrypt(self.key, self._nonce(), pt)
+
+    def decrypt(self, ct: bytes) -> bytes:
+        return aead_decrypt(self.key, self._nonce(), ct)
+
+
+class HandshakeError(ConnectionError):
+    pass
+
+
+def _send(sock, data: bytes) -> None:
+    sock.sendall(struct.pack(">H", len(data)) + data)
+
+
+def _recv(sock, recv_exact) -> bytes:
+    (n,) = struct.unpack(">H", recv_exact(sock, 2))
+    return recv_exact(sock, n)
+
+
+def handshake(sock, recv_exact, static_sk: bytes, *, initiator: bool,
+              expected_remote_static: bytes | None = None):
+    """Run the XX handshake over ``sock``.
+
+    Returns (send_cipher, recv_cipher, remote_static_pub). The caller
+    may pin ``expected_remote_static`` (e.g. from a BLS-signed
+    discovery record) — mismatch raises HandshakeError.
+    """
+    s_sk, s_pub = x25519_keypair(static_sk)
+    e_sk, e_pub = x25519_keypair()
+    sym = _Symmetric()
+
+    try:
+        if initiator:
+            # -> e
+            sym.mix_hash(e_pub)
+            _send(sock, e_pub)
+            # <- e, ee, s, es
+            re = _recv(sock, recv_exact)
+            sym.mix_hash(re)
+            sym.mix_key(x25519(e_sk, re))
+            ct_rs = _recv(sock, recv_exact)
+            rs = sym.dec(ct_rs)
+            sym.mix_key(x25519(e_sk, rs))
+            # -> s, se
+            ct_s = sym.enc(s_pub)
+            _send(sock, ct_s)
+            sym.mix_key(x25519(s_sk, re))
+            k1, k2 = _hkdf2(sym.ck, b"")
+            send_k, recv_k = k1, k2
+        else:
+            # <- e
+            re = _recv(sock, recv_exact)
+            sym.mix_hash(re)
+            # -> e, ee, s, es
+            sym.mix_hash(e_pub)
+            _send(sock, e_pub)
+            sym.mix_key(x25519(e_sk, re))
+            ct_s = sym.enc(s_pub)
+            _send(sock, ct_s)
+            sym.mix_key(x25519(s_sk, re))
+            # <- s, se
+            ct_rs = _recv(sock, recv_exact)
+            rs = sym.dec(ct_rs)
+            sym.mix_key(x25519(e_sk, rs))
+            k1, k2 = _hkdf2(sym.ck, b"")
+            send_k, recv_k = k2, k1
+    except (ValueError, struct.error) as e:
+        raise HandshakeError(f"handshake failed: {e}") from None
+
+    if expected_remote_static is not None and rs != expected_remote_static:
+        raise HandshakeError("remote static key does not match pinned record")
+    return CipherState(send_k), CipherState(recv_k), rs

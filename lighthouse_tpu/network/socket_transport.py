@@ -24,7 +24,15 @@ like the hub. Gossip deliveries land in a thread-safe inbox drained by
 ``deliver_pending`` — the deterministic drive model the node loop already
 uses. Discovery is a UDP ENR-style registry (discovery.py semantics over
 datagrams): PING registers {peer_id, host, port}, FIND returns the known
-records. The reference's noise encryption/yamux muxing are not modeled
+records; records may carry a BLS signature binding the node's transport
+static key to its identity key (the server verifies and rejects bad
+ones — discv5's signed-ENR analog).
+
+Encryption (default ON): every TCP stream runs the XX handshake from
+``secure.py`` (X25519 + ChaCha20-Poly1305 — the reference's noise
+encryption analog, lighthouse_network/src/service.rs:53-120) before any
+protocol frame; after it, each frame rides as one AEAD message with a
+per-direction counter nonce. yamux-style muxing is still not modeled
 (one TCP stream per direction; see PARITY.md gap note).
 """
 
@@ -40,7 +48,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from .gossip import message_id
-from . import snappy
+from . import secure, snappy
 
 _HELLO, _SUB, _UNSUB, _GOSSIP, _REQ, _RESP, _END = range(7)
 _MAX_FRAME = 1 << 26  # 64 MiB — a full minimal-preset state fits easily
@@ -78,20 +86,40 @@ class _Delivery:
 
 class _Conn:
     """One established peer link (either direction): writer + reader
-    thread feeding the owner's inbox."""
+    thread feeding the owner's inbox. When the owner encrypts, ``boxes``
+    holds the per-direction cipher states and every frame is one AEAD
+    message."""
 
     def __init__(self, owner: "SocketPeer", sock: socket.socket):
         self.owner = owner
         self.sock = sock
         self.peer_id: str | None = None
+        self.remote_static: bytes | None = None
         self.remote_subs: set[str] = set()
         self.alive = True
         self.wlock = threading.Lock()
+        self.boxes: tuple | None = None  # (send_cipher, recv_cipher)
         self._responses: dict[int, tuple[list, threading.Event, list]] = {}
 
     def send(self, ftype: int, payload: bytes) -> None:
         with self.wlock:
-            _send_frame(self.sock, ftype, payload)
+            if self.boxes is not None:
+                ct = self.boxes[0].encrypt(bytes([ftype]) + payload)
+                self.sock.sendall(struct.pack(">I", len(ct)) + ct)
+            else:
+                _send_frame(self.sock, ftype, payload)
+
+    def recv_frame(self) -> tuple[int, bytes]:
+        if self.boxes is not None:
+            (length,) = struct.unpack(">I", _recv_exact(self.sock, 4))
+            if not 17 <= length <= _MAX_FRAME:
+                raise ConnectionError(f"bad frame length {length}")
+            try:
+                body = self.boxes[1].decrypt(_recv_exact(self.sock, length))
+            except ValueError as e:  # tampered/replayed frame
+                raise ConnectionError(f"AEAD failure: {e}") from None
+            return body[0], body[1:]
+        return _recv_frame(self.sock)
 
     def close(self) -> None:
         self.alive = False
@@ -104,7 +132,7 @@ class _Conn:
     def run_reader(self) -> None:
         try:
             while self.alive:
-                ftype, body = _recv_frame(self.sock)
+                ftype, body = self.recv_frame()
                 self._handle(ftype, body)
         except (ConnectionError, OSError):
             pass
@@ -178,10 +206,21 @@ class _Conn:
 
 
 class SocketPeer:
-    """Socket-backed twin of transport.Peer."""
+    """Socket-backed twin of transport.Peer.
 
-    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+    ``encrypt`` (default True) runs every stream through the XX
+    handshake (secure.py); ``static_sk`` pins this node's X25519
+    identity (fresh random otherwise) — ``static_pub`` is what discovery
+    records advertise and remote peers may pin."""
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0,
+                 static_sk: bytes | None = None, encrypt: bool = True):
         self.peer_id = peer_id
+        self.encrypt = encrypt
+        if encrypt:
+            self.static_sk, self.static_pub = secure.x25519_keypair(static_sk)
+        else:
+            self.static_sk = self.static_pub = None
         self.subscriptions: set[str] = set()
         self.seen_ids: set[bytes] = set()
         self.rpc_handlers: dict[str, Callable] = {}
@@ -217,16 +256,34 @@ class SocketPeer:
                 sock, _addr = self._listener.accept()
             except OSError:
                 return
-            self._start_conn(sock)
+            self._start_conn(sock, initiator=False)
 
-    def _start_conn(self, sock: socket.socket) -> _Conn:
+    def _start_conn(self, sock: socket.socket, initiator: bool,
+                    expected_static: bytes | None = None) -> _Conn:
         conn = _Conn(self, sock)
         with self._lock:
             self._pending.append(conn)
-        conn.send(_HELLO, self.peer_id.encode())
-        for topic in sorted(self.subscriptions):
-            conn.send(_SUB, topic.encode())
-        threading.Thread(target=conn.run_reader, daemon=True).start()
+
+        def setup():
+            try:
+                if self.encrypt:
+                    send_c, recv_c, rs = secure.handshake(
+                        sock, _recv_exact, self.static_sk,
+                        initiator=initiator,
+                        expected_remote_static=expected_static,
+                    )
+                    conn.boxes = (send_c, recv_c)
+                    conn.remote_static = rs
+                conn.send(_HELLO, self.peer_id.encode())
+                for topic in sorted(self.subscriptions):
+                    conn.send(_SUB, topic.encode())
+            except (secure.HandshakeError, ConnectionError, OSError):
+                conn.close()
+                self._drop_conn(conn)
+                return
+            conn.run_reader()
+
+        threading.Thread(target=setup, daemon=True).start()
         return conn
 
     def _register_conn(self, conn: _Conn) -> None:
@@ -251,11 +308,15 @@ class SocketPeer:
             return self._req_counter
 
     # ------------------------------------------------------------- dialing
-    def connect(self, host: str, port: int, timeout: float = 5.0) -> str:
-        """Dial a remote node; returns its peer id once HELLO completes."""
+    def connect(self, host: str, port: int, timeout: float = 5.0,
+                expected_static: bytes | None = None) -> str:
+        """Dial a remote node; returns its peer id once the handshake and
+        HELLO complete. ``expected_static`` pins the remote transport
+        identity (e.g. from a signed discovery record)."""
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
-        conn = self._start_conn(sock)
+        conn = self._start_conn(sock, initiator=True,
+                                expected_static=expected_static)
         deadline = time.monotonic() + timeout
         while conn.peer_id is None:
             if time.monotonic() > deadline or not conn.alive:
@@ -386,14 +447,66 @@ class SocketHub:
 # ------------------------------------------------------------- discovery
 
 
+def _record_body(record: dict) -> bytes:
+    """Canonical signed payload: every field except the signature pair."""
+    return json.dumps(
+        {k: v for k, v in record.items() if k not in ("sig", "bls_pub")},
+        sort_keys=True,
+    ).encode()
+
+
+def derived_peer_id(bls_pub: bytes) -> str:
+    """Self-certifying peer id from the identity key (discv5 derives the
+    node id from the ENR pubkey the same way): a peer id in this form
+    cannot be claimed without the matching secret key."""
+    import hashlib
+
+    return "nid-" + hashlib.sha256(bls_pub).hexdigest()[:16]
+
+
+def sign_record(record: dict, identity_sk) -> dict:
+    """BLS-sign a discovery record with the node identity key (discv5
+    signed-ENR analog): binds host/port AND the transport static key
+    ('xpub') to the identity key. NOTE the signature alone is
+    self-certifying, not identity-proving — registries enforce either a
+    self-certified peer id (:func:`derived_peer_id`) or first-key
+    continuity (see UdpDiscoveryServer._admit) to prevent takeover of an
+    existing peer_id by a different identity key."""
+    rec = dict(record)
+    rec.pop("sig", None)
+    rec.pop("bls_pub", None)
+    sig = identity_sk.sign(_record_body(rec))
+    rec["bls_pub"] = identity_sk.public_key().to_bytes().hex()
+    rec["sig"] = sig.to_bytes().hex()
+    return rec
+
+
+def verify_record(record: dict) -> bool:
+    """True iff the record carries a valid BLS signature over its body."""
+    from ..crypto.bls.api import BlsError, PublicKey, Signature
+
+    try:
+        pk = PublicKey.from_bytes(bytes.fromhex(record["bls_pub"]))
+        sig = Signature.from_bytes(bytes.fromhex(record["sig"]))
+    except (KeyError, ValueError, BlsError):
+        return False
+    return sig.verify(pk, _record_body(record))
+
+
 class UdpDiscoveryServer:
     """ENR-registry-over-UDP (the boot node role): PING registers a
     record, FIND answers with all known records. Datagram twin of
     discovery.py's HTTP registry; capability analog of discv5's
-    bootstrap role (reference: boot_node/, discovery/mod.rs)."""
+    bootstrap role (reference: boot_node/, discovery/mod.rs).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Records carrying a ``sig`` are verified (bad signatures rejected);
+    ``require_signed=True`` additionally rejects unsigned records."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 require_signed: bool = False):
         self.records: dict[str, dict] = {}
+        self.require_signed = require_signed
+        self.rejected = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
         self.host, self.port = self._sock.getsockname()
@@ -407,6 +520,29 @@ class UdpDiscoveryServer:
         except OSError:
             pass
 
+    def _admit(self, rec) -> bool:
+        if not isinstance(rec, dict) or "peer_id" not in rec:
+            return False
+        prev = self.records.get(rec["peer_id"])
+        if "sig" in rec or "bls_pub" in rec:
+            if not verify_record(rec):
+                return False
+            # Identity binding (prevents registering an arbitrary
+            # peer_id under a fresh key): either the peer id is derived
+            # from the identity key (self-certifying), or it matches
+            # the key that FIRST registered this peer_id (continuity).
+            if rec["peer_id"] == derived_peer_id(
+                bytes.fromhex(rec["bls_pub"])
+            ):
+                return True
+            if prev is None:
+                return not self.require_signed
+            return prev.get("bls_pub") == rec["bls_pub"]
+        # Unsigned records never displace a signed registration.
+        if prev is not None and "bls_pub" in prev:
+            return False
+        return not self.require_signed
+
     def _serve(self) -> None:
         while self._alive:
             try:
@@ -419,9 +555,12 @@ class UdpDiscoveryServer:
                 continue
             if msg.get("op") == "ping" and "record" in msg:
                 rec = msg["record"]
-                if isinstance(rec, dict) and "peer_id" in rec:
+                if self._admit(rec):
                     self.records[rec["peer_id"]] = rec
                     self._sock.sendto(b'{"op":"pong"}', addr)
+                else:
+                    self.rejected += 1
+                    self._sock.sendto(b'{"op":"nack"}', addr)
             elif msg.get("op") == "find":
                 out = json.dumps(
                     {"op": "nodes", "records": list(self.records.values())}
@@ -459,20 +598,35 @@ def udp_find(boot: tuple[str, int], timeout: float = 2.0) -> list[dict]:
         sock.close()
 
 
-def discover_and_connect(peer: SocketPeer, boot: tuple[str, int]) -> int:
-    """Register ourselves, then dial every other advertised node."""
-    udp_register(
-        boot,
-        {"peer_id": peer.peer_id, "host": peer.host, "port": peer.port},
-    )
+def discover_and_connect(peer: SocketPeer, boot: tuple[str, int],
+                         identity_sk=None) -> int:
+    """Register ourselves, then dial every other advertised node.
+
+    With ``identity_sk`` (a BLS SecretKey) the record is signed and
+    includes our transport static key; when dialing, signed records are
+    verified and their 'xpub' pinned into the handshake — an
+    impersonating registry entry can then neither register (bad sig)
+    nor survive the handshake (static mismatch)."""
+    record = {"peer_id": peer.peer_id, "host": peer.host, "port": peer.port}
+    if peer.static_pub is not None:
+        record["xpub"] = peer.static_pub.hex()
+    if identity_sk is not None:
+        record = sign_record(record, identity_sk)
+    udp_register(boot, record)
     n = 0
     for rec in udp_find(boot):
         if rec["peer_id"] == peer.peer_id:
             continue
         if rec["peer_id"] in peer.connected_peers():
             continue
+        pin = None
+        if "sig" in rec:
+            if not verify_record(rec):
+                continue
+            if "xpub" in rec:
+                pin = bytes.fromhex(rec["xpub"])
         try:
-            peer.connect(rec["host"], int(rec["port"]))
+            peer.connect(rec["host"], int(rec["port"]), expected_static=pin)
             n += 1
         except (ConnectionError, OSError):
             continue

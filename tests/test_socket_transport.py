@@ -175,3 +175,132 @@ def test_two_process_sync_and_finalize(tmp_path):
     assert result["finalized_epoch"] == int(
         parent_head.state.finalized_checkpoint.epoch
     )
+
+
+def test_streams_are_encrypted_on_the_wire():
+    """Sniff the TCP bytes of a gossip publish: the topic and payload
+    must NOT appear in cleartext (VERDICT r2 item 8), and both ends must
+    have completed the XX handshake with matching statics."""
+    import socket as _socket
+    import threading
+
+    from lighthouse_tpu.network.socket_transport import SocketPeer
+
+    a = SocketPeer("enc-a")
+    b = SocketPeer("enc-b")
+    try:
+        captured = []
+
+        # a MITM tap: forward bytes between a and b, recording them
+        tap = _socket.socket()
+        tap.bind(("127.0.0.1", 0))
+        tap.listen(1)
+        tport = tap.getsockname()[1]
+
+        def pump(src, dst):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        return
+                    captured.append(data)
+                    dst.sendall(data)
+            except OSError:
+                return
+
+        def relay():
+            up, _ = tap.accept()
+            down = _socket.create_connection(("127.0.0.1", b.port))
+            threading.Thread(target=pump, args=(down, up), daemon=True).start()
+            pump(up, down)
+
+        threading.Thread(target=relay, daemon=True).start()
+
+        assert a.connect("127.0.0.1", tport) == "enc-b"
+        b.subscribe("secret_topic")
+        a.subscribe("secret_topic")
+        time.sleep(0.3)
+        payload = snappy.compress(b"SUPER-SECRET-ATTESTATION-BYTES")
+        a.publish("secret_topic", payload)
+        assert b.wait_for_messages(2.0)
+        wire = b"".join(captured)
+        assert b"secret_topic" not in wire
+        assert b"SUPER-SECRET" not in wire
+        assert snappy.compress(b"SUPER-SECRET-ATTESTATION-BYTES") not in wire
+        # identity binding: each side learned the other's static key
+        conn_ab = a._conns["enc-b"]
+        conn_ba = b._conns["enc-a"]
+        assert conn_ab.remote_static == b.static_pub
+        assert conn_ba.remote_static == a.static_pub
+    finally:
+        a.close()
+        b.close()
+
+
+def test_signed_discovery_records():
+    """BLS-signed records: the registry rejects forgeries; dialers pin
+    the advertised transport static through the handshake."""
+    from lighthouse_tpu.crypto.bls.api import SecretKey
+    from lighthouse_tpu.network.socket_transport import (
+        SocketPeer,
+        UdpDiscoveryServer,
+        discover_and_connect,
+        sign_record,
+        udp_find,
+        udp_register,
+        verify_record,
+    )
+
+    from lighthouse_tpu.network.socket_transport import derived_peer_id
+
+    ka, kb = SecretKey.from_int(1234), SecretKey.from_int(5678)
+    pid_a = derived_peer_id(ka.public_key().to_bytes())
+    pid_b = derived_peer_id(kb.public_key().to_bytes())
+
+    boot = UdpDiscoveryServer(require_signed=True)
+    a = SocketPeer(pid_a)
+    b = SocketPeer(pid_b)
+    try:
+        # unsigned record rejected under require_signed
+        assert not udp_register(
+            (boot.host, boot.port),
+            {"peer_id": "plain", "host": "127.0.0.1", "port": 1},
+        )
+
+        # forged record (signature over different body) rejected
+        good = sign_record(
+            {"peer_id": pid_b, "host": b.host, "port": b.port,
+             "xpub": b.static_pub.hex()},
+            kb,
+        )
+        forged = dict(good)
+        forged["port"] = forged["port"] + 1
+        assert verify_record(good)
+        assert not verify_record(forged)
+        assert not udp_register((boot.host, boot.port), forged)
+
+        # impersonation: a fresh key cannot claim someone else's derived
+        # peer id (self-certifying ids) even with a VALID signature
+        mallory = SecretKey.from_int(999)
+        stolen = sign_record(
+            {"peer_id": pid_b, "host": "127.0.0.1", "port": 7,
+             "xpub": "00" * 32},
+            mallory,
+        )
+        assert verify_record(stolen)  # internally consistent...
+        assert not udp_register((boot.host, boot.port), stolen)  # ...rejected
+
+        # honest flow: both register signed, then connect with pinning
+        assert discover_and_connect(b, (boot.host, boot.port), kb) == 0
+        n = discover_and_connect(a, (boot.host, boot.port), ka)
+        assert n == 1
+        deadline = time.time() + 5
+        while pid_a not in b.connected_peers() and time.time() < deadline:
+            time.sleep(0.05)
+        assert pid_b in a.connected_peers()
+        assert len(udp_find((boot.host, boot.port))) == 2
+        assert boot.rejected >= 3
+    finally:
+        boot.close()
+        a.close()
+        b.close()
