@@ -103,3 +103,30 @@ def test_indexed_fallbacks(table_registered):
     assert backend._table_gather_args(sets, 2, 1) is None
     # verification still works via fallback
     assert backend.verify_signature_sets(_sets_with_indices(sks, 2))
+
+
+def test_build_sequential_table_matches_oracle():
+    """Device-built fixture table (bench config #5): pk_i = (i+1)G rows
+    must equal the oracle's scalar multiples, bit-for-bit in the uint8
+    Montgomery planes."""
+    import numpy as np
+
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.crypto.bls.curve import g1_generator
+    from lighthouse_tpu.ops.points import g1_from_dev, g1_to_dev
+
+    n = 6
+    table = blsrt.build_sequential_table(n, chunk=4)
+    assert len(table) == n
+    g1 = g1_generator()
+    pts = g1_from_dev(
+        table._host_x[:n].astype(np.int32),
+        table._host_y[:n].astype(np.int32),
+        np.zeros(n, bool),
+    )
+    for i, pt in enumerate(pts):
+        assert pt == g1.mul(i + 1), f"row {i}"
+    # bitwise: the planes are exactly the canonical Montgomery limbs
+    xs, ys, _ = g1_to_dev([g1.mul(i + 1) for i in range(1, n + 1)])
+    assert (table._host_x[:n] == xs.astype(np.uint8)).all()
+    assert (table._host_y[:n] == ys.astype(np.uint8)).all()
