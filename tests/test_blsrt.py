@@ -127,6 +127,6 @@ def test_build_sequential_table_matches_oracle():
     for i, pt in enumerate(pts):
         assert pt == g1.mul(i + 1), f"row {i}"
     # bitwise: the planes are exactly the canonical Montgomery limbs
-    xs, ys, _ = g1_to_dev([g1.mul(i + 1) for i in range(1, n + 1)])
+    xs, ys, _ = g1_to_dev([g1.mul(i) for i in range(1, n + 1)])
     assert (table._host_x[:n] == xs.astype(np.uint8)).all()
     assert (table._host_y[:n] == ys.astype(np.uint8)).all()
