@@ -66,30 +66,27 @@ def slot_mode() -> None:
     from lighthouse_tpu.crypto.bls.curve import g1_generator, g2_generator
     from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
     from lighthouse_tpu.jax_backend import JaxBackend
-    from lighthouse_tpu.ops.points import _mont_batch
 
-    N = int(os.environ.get("BENCH_VALIDATORS", "100000"))
+    N = int(os.environ.get("BENCH_VALIDATORS", "1000000"))
     S = int(os.environ.get("BENCH_COMMITTEES", "64"))
     K = int(os.environ.get("BENCH_COMMITTEE_SIZE", "512"))
 
-    # Registry: pk_i = (i+1) * G by running addition; straight into the
-    # uint8 HBM planes (bypassing per-object PublicKey wrappers).
+    # Registry: pk_i = (i+1) * G, built ON DEVICE (blsrt.build_sequential
+    # _table — batched scalar-mul + to-affine kernels; round 2's host
+    # loop made 1M impractical). Verified spot-wise against the oracle.
     t0 = time.perf_counter()
+    table = blsrt.build_sequential_table(N)
     g1 = g1_generator()
-    xs = np.empty((N, 48), np.uint8)
-    ys = np.empty((N, 48), np.uint8)
-    acc = g1
-    xints, yints = [], []
-    for i in range(N):
-        xints.append(acc.x.n)
-        yints.append(acc.y.n)
-        acc = acc.add(g1)
-    xs[:] = _mont_batch(xints).astype(np.uint8)
-    ys[:] = _mont_batch(yints).astype(np.uint8)
-    table = blsrt.DevicePubkeyTable()
-    table._host_x, table._host_y = xs, ys
-    table._n = table._cap = N
-    table._dirty = True
+    from lighthouse_tpu.ops.points import g1_from_dev
+
+    spot = [0, 1, min(N - 1, 12345)]
+    pts = g1_from_dev(
+        table._host_x[spot].astype(np.int32),
+        table._host_y[spot].astype(np.int32),
+        np.zeros(len(spot), bool),
+    )
+    for i, pt in zip(spot, pts):
+        assert pt == g1.mul(i + 1), f"table row {i} wrong"
     blsrt.set_device_table(table)
     build_s = time.perf_counter() - t0
 
@@ -114,19 +111,225 @@ def slot_mode() -> None:
     t0 = time.perf_counter()
     ok = backend.verify_signature_sets(sets) and ok
     dt = time.perf_counter() - t0
+
+    # Native single-core denominator on a subsample (2 sets with REAL
+    # PublicKey objects reconstructed from the table planes), scaled to
+    # the slot's set count. Round 2 hardcoded vs_baseline 0.0 here.
+    native_slot_s = None
+    native_err = None
+    try:
+        from lighthouse_tpu.crypto.bls.native_backend import (
+            load_native_backend,
+        )
+
+        nb = load_native_backend()
+        if nb is not None:
+            nsub = 2
+            sub = []
+            for s in sets[:nsub]:
+                idxs = s.signing_key_indices
+                pts = g1_from_dev(
+                    table._host_x[idxs].astype(np.int32),
+                    table._host_y[idxs].astype(np.int32),
+                    np.zeros(len(idxs), bool),
+                )
+                real_pks = [PublicKey(p) for p in pts]
+                sub.append(SignatureSet(
+                    s.signature, real_pks, s.message
+                ))
+            assert nb.verify_signature_sets(sub)  # warm
+            t0 = time.perf_counter()
+            assert nb.verify_signature_sets(sub)
+            native_slot_s = (time.perf_counter() - t0) * (S / nsub)
+    except Exception as e:  # record — a native/device DISAGREEMENT must
+        native_err = str(e)[:200]  # not masquerade as a missing toolchain
+
     print(json.dumps({
         "metric": "full_slot_attester_verifications_per_sec",
         "value": round(S * K / dt, 1),
         "unit": "attester-signatures/sec",
-        "vs_baseline": 0.0,
+        "vs_baseline": (
+            round(native_slot_s / dt, 3) if native_slot_s else 0.0
+        ),
         "detail": {
             "validators": N, "sets": S, "committee_size": K,
             "verified": bool(ok),
             "slot_ms": round(dt * 1e3, 1),
+            "slot_budget_s": 12.0,
+            "within_budget": dt < 12.0,
             "sets_per_sec": round(S / dt, 2),
+            "native_cpu_slot_s_scaled": (
+                round(native_slot_s, 2) if native_slot_s else None
+            ),
+            "native_cpu_error": native_err,
             "table_build_s": round(build_s, 1),
             "table_hbm_mb": round(N * 96 / 1e6, 1),
+            # Pubkey deserialization/subgroup checks are excluded BY
+            # DESIGN: registry keys enter the HBM table once at import
+            # (validated there), per-slot verification ships indices.
+            "pubkey_objects": "table-resident (deserialization at import)",
             "device": jax.devices()[0].platform,
+        },
+    }))
+
+
+def _vs_target(e2e_rate: float, native_rate: float | None, detail: dict) -> float:
+    """BASELINE target: >=10x blst on a 64-core CPU (BASELINE.md).
+
+    Derivation (also in README): the measured in-repo native C++ is
+    portable (no-asm) single-core; crediting it as blst-equivalent and
+    linear core scaling, target = native * 64 cores * 10. With the
+    round-2 measurement (~283 sets/s/core) that is ~181k sets/s. This
+    UNDERSTATES the real bar by blst's asm advantage (~2-4x/core);
+    vs_target reads "fraction of the credited target achieved"."""
+    if not native_rate:
+        return 0.0
+    target = native_rate * 64 * 10
+    detail["target_sets_per_sec"] = round(target, 1)
+    return round(e2e_rate / target, 4)
+
+
+def _mk_key_pool(n: int):
+    """n deterministic keys: sk_i = i+1, pk by running G1 addition (one
+    host point-add per key, not a scalar mul — fixture trick shared with
+    slot_mode)."""
+    from lighthouse_tpu.crypto.bls.api import PublicKey
+    from lighthouse_tpu.crypto.bls.curve import g1_generator
+
+    g1 = g1_generator()
+    acc = g1
+    pks = []
+    for _ in range(n):
+        pks.append(PublicKey(acc))
+        acc = acc.add(g1)
+    return pks
+
+
+def configs_mode(backend, nb) -> None:
+    """BASELINE configs #1-#3, one JSON line each (VERDICT r2 item 6):
+      #1 BLS aggregate_verify (128 distinct-message pairs, one aggregate)
+      #2 mainnet-block signature batch (~128 mixed-K attestation sets
+         + proposal/randao/exit singles)
+      #3 sync-committee fast_aggregate_verify (512 keys, one set)
+    Each line's vs_baseline divides by the measured native-CPU rate for
+    the SAME workload (single core, portable C++)."""
+    import jax
+
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        SignatureSet,
+    )
+    from lighthouse_tpu.crypto.bls.constants import R as CURVE_ORDER
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.jax_backend import aggregate_verify_device
+
+    dev = jax.devices()[0].platform
+    pool = _mk_key_pool(512)
+
+    def agg_sig_for(idxs, msg):
+        sk_sum = sum(i + 1 for i in idxs) % CURVE_ORDER
+        return AggregateSignature(hash_to_g2(msg).mul(sk_sum))
+
+    # ---- config #1: aggregate_verify, 128 pairs ------------------------
+    n1 = 128
+    msgs1 = [i.to_bytes(32, "big") for i in range(n1)]
+    pks1 = pool[:n1]
+    # aggregate signature = sum_i sk_i * H(m_i); sk_i = i+1
+    acc = None
+    for i, m in enumerate(msgs1):
+        term = hash_to_g2(m).mul(i + 1)
+        acc = term if acc is None else acc.add(term)
+    agg1 = AggregateSignature(acc)
+
+    assert aggregate_verify_device(pks1, msgs1, agg1)  # compile + warm
+    t0 = time.perf_counter()
+    assert aggregate_verify_device(pks1, msgs1, agg1)
+    dt1 = time.perf_counter() - t0
+    nat1 = None
+    if nb is not None:
+        assert nb.aggregate_verify(pks1, msgs1, agg1)
+        t0 = time.perf_counter()
+        assert nb.aggregate_verify(pks1, msgs1, agg1)
+        nat1 = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bls_aggregate_verify_pairs_per_sec",
+        "value": round(n1 / dt1, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": round((nat1 / dt1), 3) if nat1 else 0.0,
+        "detail": {
+            "config": 1, "pairs": n1, "device": dev,
+            "device_ms": round(dt1 * 1e3, 1),
+            "native_cpu_ms": round(nat1 * 1e3, 1) if nat1 else None,
+        },
+    }))
+
+    # ---- config #2: mainnet-block signature batch ----------------------
+    # ~128 attestation sets with mixed committee sizes + proposal/randao/
+    # exit singletons (reference: block_signature_verifier.rs:147 collects
+    # exactly this shape).
+    sets2 = []
+    rng_sizes = [32 + (i * 13) % 97 for i in range(128)]  # 32..128 mixed K
+    for j, k in enumerate(rng_sizes):
+        lo = (j * 7) % (512 - k)
+        idxs = list(range(lo, lo + k))
+        msg = (10_000 + j).to_bytes(32, "big")
+        sets2.append(SignatureSet.multiple_pubkeys(
+            agg_sig_for(idxs, msg), [pool[i] for i in idxs], msg
+        ))
+    for j in range(4):  # proposal, randao, 2 exits
+        msg = (20_000 + j).to_bytes(32, "big")
+        sets2.append(SignatureSet.multiple_pubkeys(
+            agg_sig_for([j], msg), [pool[j]], msg
+        ))
+
+    assert backend.verify_signature_sets(sets2)  # compile + warm
+    t0 = time.perf_counter()
+    assert backend.verify_signature_sets(sets2)
+    dt2 = time.perf_counter() - t0
+    nat2 = None
+    if nb is not None:
+        assert nb.verify_signature_sets(sets2)
+        t0 = time.perf_counter()
+        assert nb.verify_signature_sets(sets2)
+        nat2 = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "block_batch_sets_per_sec",
+        "value": round(len(sets2) / dt2, 1),
+        "unit": "sets/sec",
+        "vs_baseline": round(nat2 / dt2, 3) if nat2 else 0.0,
+        "detail": {
+            "config": 2, "sets": len(sets2),
+            "attester_sigs": sum(len(s.signing_keys) for s in sets2),
+            "device": dev, "device_ms": round(dt2 * 1e3, 1),
+            "native_cpu_ms": round(nat2 * 1e3, 1) if nat2 else None,
+        },
+    }))
+
+    # ---- config #3: 512-key fast_aggregate_verify ----------------------
+    msg3 = (30_000).to_bytes(32, "big")
+    idxs3 = list(range(512))
+    set3 = SignatureSet.multiple_pubkeys(
+        agg_sig_for(idxs3, msg3), [pool[i] for i in idxs3], msg3
+    )
+    assert backend.verify_signature_sets([set3])  # compile + warm
+    t0 = time.perf_counter()
+    assert backend.verify_signature_sets([set3])
+    dt3 = time.perf_counter() - t0
+    nat3 = None
+    if nb is not None:
+        assert nb.verify_signature_sets([set3])
+        t0 = time.perf_counter()
+        assert nb.verify_signature_sets([set3])
+        nat3 = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "fast_aggregate_verify_512_per_sec",
+        "value": round(1 / dt3, 2),
+        "unit": "verifications/sec",
+        "vs_baseline": round(nat3 / dt3, 3) if nat3 else 0.0,
+        "detail": {
+            "config": 3, "keys": 512, "device": dev,
+            "device_ms": round(dt3 * 1e3, 1),
+            "native_cpu_ms": round(nat3 * 1e3, 1) if nat3 else None,
         },
     }))
 
@@ -166,11 +369,10 @@ def main() -> None:
     from lighthouse_tpu.ops.points import g1_to_dev, g2_to_dev
 
     quick = "--quick" in sys.argv
-    # Default batch 2048: bounds compile time and matches the
-    # gossip-batch accumulation size (BASELINE config #4). Throughput
-    # still grows with batch.
-    S = int(os.environ.get("BENCH_SETS", "4" if quick else "2048"))
-    REPS = int(os.environ.get("BENCH_REPS", "1" if quick else "2"))
+    # Default batch 4096 (VERDICT r2 item 1: push S with the persistent
+    # compile cache; throughput still grows with batch).
+    S = int(os.environ.get("BENCH_SETS", "4" if quick else "4096"))
+    REPS = int(os.environ.get("BENCH_REPS", "1" if quick else "3"))
     BASELINE_SETS = int(os.environ.get("BENCH_BASELINE_SETS", "2" if quick else "48"))
 
     # --- build a valid S-set batch (distinct keys, distinct messages) -------
@@ -190,7 +392,10 @@ def main() -> None:
     px, py, pinf = px.reshape(S, 1, 48), py.reshape(S, 1, 48), pinf.reshape(S, 1)
     sx, sy, sinf = g2_to_dev([s.signature.point for s in sets])
     mx, my, minf = g2_to_dev([hash_to_g2(m) for m in msgs])
-    r_bits = _rand_bits_array(S)
+    from lighthouse_tpu.jax_backend import _rand_scalars
+    from lighthouse_tpu.ops import msm as _msm
+
+    r_u64, r_bits = _rand_scalars(S)
 
     dev_args = (
         (jnp.asarray(px), jnp.asarray(py)), jnp.asarray(pinf),
@@ -198,18 +403,19 @@ def main() -> None:
         (jnp.asarray(mx), jnp.asarray(my)), jnp.asarray(minf),
         jnp.asarray(r_bits),
     )
+    # Bucketed-MSM schedule: the fused production path (ops/msm.py).
+    if fused_choice == "1" and os.environ.get("LHTPU_MSM_VERIFY", "1") == "1":
+        sched = _msm.build_schedule(r_u64, _msm.max_rounds(S))
+        if sched is not None:
+            dev_args = dev_args + (jnp.asarray(sched[0]), jnp.asarray(sched[1]))
 
     # --- exactness gate on this device (incl. compile/warmup) --------------
     ok = bool(_verify(*dev_args))
     bad_sy = np.array(sy)
     bad_sy[0] = sy[(1 if S > 1 else 0)]  # swap in a mismatched signature
-    bad = bool(
-        _verify(
-            dev_args[0], dev_args[1],
-            (jnp.asarray(sx), jnp.asarray(bad_sy)), dev_args[3],
-            dev_args[4], dev_args[5], dev_args[6],
-        )
-    )
+    bad_args = list(dev_args)
+    bad_args[2] = (jnp.asarray(sx), jnp.asarray(bad_sy))
+    bad = bool(_verify(*bad_args))
     if not ok or (S > 1 and bad):
         print(json.dumps({"metric": "bls_sets_verified_per_sec", "value": 0.0,
                           "unit": "sets/sec", "vs_baseline": 0.0,
@@ -226,8 +432,19 @@ def main() -> None:
     # --- timed: end-to-end through the backend ------------------------------
     assert backend.verify_signature_sets(sets)  # compile/warm the htc path
     t0 = time.perf_counter()
+    assert backend.verify_signature_sets(sets)
+    e2e_sync_dt = time.perf_counter() - t0
+
+    # Steady-state pipelined e2e (the headline): async dispatch lets the
+    # host assemble/hash batch i+1 while batch i verifies on device —
+    # what a chain under sustained gossip load sees (VERDICT r2 item 2;
+    # the reference hides verification behind worker pools,
+    # beacon_processor/mod.rs:1004-1070).
+    pend = []
+    t0 = time.perf_counter()
     for _ in range(REPS):
-        assert backend.verify_signature_sets(sets)
+        pend.append(backend.verify_signature_sets_async(sets))
+    assert all(resolve() for resolve in pend)
     e2e_dt = (time.perf_counter() - t0) / REPS
     e2e_rate = S / e2e_dt
 
@@ -238,6 +455,8 @@ def main() -> None:
         "device_only_sets_per_sec": round(dev_rate, 3),
         "device_only_ms_per_batch": round(dev_dt * 1e3, 2),
         "e2e_ms_per_batch": round(e2e_dt * 1e3, 2),
+        "e2e_sync_ms_per_batch": round(e2e_sync_dt * 1e3, 2),
+        "e2e_pipelined": True,
         "cpu_cores": os.cpu_count(),
     }
     native_rate = None
@@ -264,12 +483,26 @@ def main() -> None:
         max(2, BASELINE_SETS // 8) / py_dt, 3
     )
 
+    # --- BASELINE configs #1-#3 (their own JSON lines; headline stays
+    # last so the driver's single-line parse keeps working) --------------
+    configs = os.environ.get("BENCH_CONFIGS")
+    if configs is None:
+        configs = "1" if (jax.default_backend() == "tpu" and not quick) else "0"
+    if configs == "1":
+        try:
+            nb_handle = nb if native_rate else None
+        except NameError:
+            nb_handle = None
+        configs_mode(backend, nb_handle)
+
     base = native_rate if native_rate else detail["cpu_python_sets_per_sec"]
+    vs_target = _vs_target(e2e_rate, native_rate, detail)
     print(json.dumps({
         "metric": "bls_sets_verified_per_sec",
         "value": round(e2e_rate, 3),
         "unit": "sets/sec",
         "vs_baseline": round(e2e_rate / base, 3),
+        "vs_target": vs_target,
         "detail": detail,
     }))
 

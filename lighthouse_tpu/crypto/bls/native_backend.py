@@ -71,6 +71,20 @@ class NativeBackend:
         )
         return rc == 1
 
+    def aggregate_verify(self, pubkeys, messages, signature) -> bool:
+        """IETF AggregateVerify (api.AggregateSignature.aggregate_verify
+        semantics) in one native call — BASELINE config #1 denominator."""
+        if not pubkeys or len(pubkeys) != len(messages):
+            return False
+        n = len(pubkeys)
+        pks = b"".join(_pack_g1(pk.point) for pk in pubkeys)
+        if any(len(m) != 32 for m in messages):
+            raise ValueError("messages must be 32 bytes")
+        rc = self._lib.lhbls_aggregate_verify(
+            pks, b"".join(messages), n, _pack_g2(signature.point)
+        )
+        return rc == 1
+
     # ------------------------------------------------------- test helpers
     def hash_to_g2_bytes(self, msg: bytes) -> tuple[bytes, bool]:
         out = ctypes.create_string_buffer(192)

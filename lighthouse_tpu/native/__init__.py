@@ -158,6 +158,11 @@ def load_lhbls():
             lib.lhbls_pairing.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ]
+            lib.lhbls_aggregate_verify.restype = ctypes.c_int
+            lib.lhbls_aggregate_verify.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_char_p,
+            ]
             from ..crypto.bls.constants import DST
 
             blob = _bls_const_blob()

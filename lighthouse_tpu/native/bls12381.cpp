@@ -897,6 +897,31 @@ extern "C" int lhbls_verify_batch(const uint8_t* pks, const uint32_t* counts,
     return f12_is_one(final_exp(f)) ? 1 : 0;
 }
 
+// IETF AggregateVerify (generic_aggregate_signature.rs aggregate_verify
+// semantics): prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1, one final exp.
+//   pks:  n*96 bytes affine G1 (all-zero = infinity -> invalid)
+//   msgs: n*32-byte messages
+//   sig:  192 bytes affine G2 (infinity -> invalid)
+// Returns 1 iff the aggregate verifies. The native denominator for
+// BASELINE config #1.
+extern "C" int lhbls_aggregate_verify(const uint8_t* pks, const uint8_t* msgs,
+                                      u64 n, const uint8_t* sig_bytes) {
+    if (!READY || n == 0) return 0;
+    aff<fp2> sig = read_g2(sig_bytes);
+    if (sig.inf) return 0;
+    if (!g2_subgroup_check(sig)) return 0;
+    fp12 f = f12_one();
+    for (u64 i = 0; i < n; i++) {
+        aff<fp> pk = read_g1(pks + i * 96);
+        if (pk.inf) return 0;
+        aff<fp2> h = hash_to_g2(msgs + i * 32, 32);
+        f = f12_mul(f, miller_loop(pk, h));
+    }
+    aff<fp> neg_g1 = {G1_GEN.x, fp_neg(G1_GEN.y), false};
+    f = f12_mul(f, miller_loop(neg_g1, sig));
+    return f12_is_one(final_exp(f)) ? 1 : 0;
+}
+
 // Single full pairing for tests: e(P, Q), output as 12 fp (standard bytes).
 extern "C" int lhbls_pairing(const uint8_t* g1_96, const uint8_t* g2_192,
                              uint8_t* out576) {

@@ -300,18 +300,25 @@ def _map_to_g2_fused(u):
     return tc.to_affine_g2_t(Qc)
 
 
-def hash_to_g2_fused(msgs, dst=None):
-    """Full batched hash_to_curve through the fused kernels: messages ->
-    classic-layout affine (x[n,2,48], y[n,2,48], inf[n]) numpy arrays.
-    Host side is identical to htc.hash_to_g2_batch (SHA-256 + field
-    reduction); the curve mapping runs as two Pallas chains."""
+def hash_to_g2_fused_dev(msgs, dst=None):
+    """Batched hash_to_curve through the fused kernels, results left ON
+    DEVICE: messages -> classic-layout affine (x[n,2,48], y[n,2,48],
+    inf[n]) jax arrays. Host side is SHA-256 + field reduction
+    (htc.hash_to_field_dev); the curve mapping runs as two Pallas
+    chains. Keeping the outputs device-resident lets the verify program
+    consume them without a host round-trip (the round-2 path downloaded
+    to numpy and re-uploaded — two tunnel transfers plus a sync
+    barrier per batch; VERDICT r2 item 2)."""
     from .htc import DST as _DST
     from .htc import hash_to_field_dev
 
     u = jnp.asarray(hash_to_field_dev(msgs, _DST if dst is None else dst))
     x, y, inf = _map_to_g2_fused(u)
-    return (
-        np.asarray(tk.batch_from_t(x)),
-        np.asarray(tk.batch_from_t(y)),
-        np.asarray(inf),
-    )
+    return tk.batch_from_t(x), tk.batch_from_t(y), inf
+
+
+def hash_to_g2_fused(msgs, dst=None):
+    """numpy-materializing wrapper of :func:`hash_to_g2_fused_dev`
+    (tests / host consumers)."""
+    x, y, inf = hash_to_g2_fused_dev(msgs, dst)
+    return np.asarray(x), np.asarray(y), np.asarray(inf)

@@ -1,12 +1,14 @@
 """Multi-chip parallelism: mesh construction + sharded batch verification."""
 
 from .sharding import (
+    build_sharded_fused_indexed_verifier,
     build_sharded_fused_verifier,
     build_sharded_verifier,
     make_mesh,
 )
 
 __all__ = [
+    "build_sharded_fused_indexed_verifier",
     "build_sharded_fused_verifier",
     "build_sharded_verifier",
     "make_mesh",

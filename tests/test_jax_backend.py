@@ -62,3 +62,28 @@ def test_backend_dispatch():
     sets = _valid_sets()
     assert verify_signature_sets(sets, backend="jax")
     assert verify_signature_sets(sets, backend="fake")
+
+
+def test_aggregate_verify_device_matches_oracle():
+    """Device AggregateVerify (BASELINE config #1 path) vs the host
+    oracle, incl. a tampered-message rejection."""
+    from lighthouse_tpu.jax_backend import aggregate_verify_device
+
+    msgs = [M0, M1]
+    sigs = [SKS[0].sign(M0), SKS[1].sign(M1)]
+    agg = AggregateSignature.aggregate(sigs)
+    pks = [PKS[0], PKS[1]]
+
+    assert agg.aggregate_verify(pks, msgs)
+    assert aggregate_verify_device(pks, msgs, agg)
+
+    bad_msgs = [M0, b"\x33" * 32]
+    assert not agg.aggregate_verify(pks, bad_msgs)
+    assert not aggregate_verify_device(pks, bad_msgs, agg)
+
+    # structural: empty, length mismatch, infinity signature
+    assert not aggregate_verify_device([], [], agg)
+    assert not aggregate_verify_device(pks, [M0], agg)
+    assert not aggregate_verify_device(
+        pks, msgs, AggregateSignature.infinity()
+    )
