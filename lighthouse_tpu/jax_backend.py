@@ -504,6 +504,35 @@ class JaxBackend:
         import os
 
         n = len(sets)
+        total_keys = sum(len(s.signing_keys) for s in sets)
+
+        # Small-batch host fallback (SURVEY §7.3: "keep a host CPU
+        # fallback path for singletons"): device dispatch latency
+        # (~110 ms measured through this TPU's tunnel) dwarfs tiny
+        # batches that the native C++ backend verifies in milliseconds
+        # — e.g. one 512-key sync-committee set: 13.6 ms native vs
+        # 329 ms device (bench config #3). Cost model from those
+        # measurements; LHTPU_HOST_FALLBACK=0 disables, the threshold
+        # is LHTPU_HOST_FALLBACK_MS. TPU-only so CPU tests keep
+        # exercising the device paths.
+        if (
+            os.environ.get("LHTPU_HOST_FALLBACK", "1") == "1"
+            and jax.default_backend() == "tpu"
+        ):
+            est_native_ms = 3.3 * n + 0.05 * total_keys
+            if est_native_ms < float(
+                os.environ.get("LHTPU_HOST_FALLBACK_MS", "250")
+            ):
+                try:
+                    from .crypto.bls.native_backend import load_native_backend
+
+                    nb = load_native_backend()
+                except Exception:
+                    nb = None
+                if nb is not None:
+                    self.last_path = "native-fallback"
+                    return bool(nb.verify_signature_sets(sets))
+
         S = _next_pow2(n)
         K = _next_pow2(max(len(s.signing_keys) for s in sets))
 

@@ -157,17 +157,32 @@ def _cur() -> list:
     return _TLS.cur
 
 
+def _is_tracer(v) -> bool:
+    from jax.core import Tracer
+
+    return isinstance(v, Tracer)
+
+
 def _bundle():
     cur = _cur()
     if cur[0] is None:
-        cur[0] = jnp.asarray(CONSTS_NP)
+        val = jnp.asarray(CONSTS_NP)
+        if _is_tracer(val):
+            # Inside a trace (e.g. a Pallas kernel body that lifted the
+            # constant): usable for THIS trace but must never be cached
+            # — a stale tracer in the TLS poisons every later trace.
+            return val
+        cur[0] = val
     return cur[0]
 
 
 def _pinv_bits():
     cur = _cur()
     if cur[1] is None:
-        cur[1] = jnp.asarray(PINV_BITS_NP.reshape(-1, 1))
+        val = jnp.asarray(PINV_BITS_NP.reshape(-1, 1))
+        if _is_tracer(val):
+            return val
+        cur[1] = val
     return cur[1]
 
 
@@ -243,15 +258,37 @@ def _carry_norm(t):
 
 
 def add_t(a, b):
-    """(a + b) mod-ish, in [0, 2p) (limb.add semantics)."""
-    s, _ = _carry_norm(a + b)
-    d, borrow = _carry_norm(s - _c("TWO_P"))
+    """(a + b) mod-ish, in [0, 2p) (limb.add semantics).
+
+    The sum and its 2p-reduction ride ONE stacked carry pass: the
+    sequential carry chain's cost is per-instruction, not per-row
+    (measured on v5e — a second stacked value is nearly free, two
+    chains cost double).
+
+    Correctness of carrying s-2p BEFORE s is normalized: limb-wise,
+    (a + b) - 2p has identical digit sums either way; carry
+    propagation is linear over the un-normalized digit vector.
+    """
+    s_raw = a + b
+    shape = jnp.broadcast_shapes(s_raw.shape, _c("TWO_P").shape)
+    s_raw = jnp.broadcast_to(s_raw, shape)
+    both, carries = _carry_norm(
+        jnp.stack([s_raw, s_raw - _c("TWO_P")])
+    )
+    s, d = both[0], both[1]
+    borrow = carries[1]
     return jnp.where((borrow == 0)[..., None, :], d, s)
 
 
 def sub_t(a, b):
-    d2, borrow = _carry_norm(a - b)
-    d1, _ = _carry_norm(a - b + _c("TWO_P"))
+    d_raw = a - b
+    shape = jnp.broadcast_shapes(d_raw.shape, _c("TWO_P").shape)
+    d_raw = jnp.broadcast_to(d_raw, shape)
+    both, carries = _carry_norm(
+        jnp.stack([d_raw, d_raw + _c("TWO_P")])
+    )
+    d2, d1 = both[0], both[1]
+    borrow = carries[0]
     return jnp.where((borrow == 0)[..., None, :], d2, d1)
 
 

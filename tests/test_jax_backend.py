@@ -87,3 +87,30 @@ def test_aggregate_verify_device_matches_oracle():
     assert not aggregate_verify_device(
         pks, msgs, AggregateSignature.infinity()
     )
+
+
+def test_small_batch_routes_to_native_fallback(monkeypatch):
+    """Tiny batches route to the native C++ host backend (device
+    dispatch latency dwarfs them — SURVEY §7.3 singleton fallback);
+    big batches stay on device. TPU-gated in production; emulated here."""
+    import lighthouse_tpu.jax_backend as jb
+    from lighthouse_tpu.crypto.bls.native_backend import load_native_backend
+
+    if load_native_backend() is None:
+        pytest.skip("native toolchain unavailable")
+
+    monkeypatch.setattr(jb.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("LHTPU_HOST_FALLBACK", "1")
+    # keep the would-be device path off the fused/TPU-only kernels if a
+    # big batch ever got past the router in this emulated environment
+    monkeypatch.setenv("LHTPU_FUSED_VERIFY", "0")
+    monkeypatch.setenv("LHTPU_SHARDED_VERIFY", "0")
+
+    backend = jb.JaxBackend()
+    sets = _valid_sets()
+    assert backend.verify_signature_sets(sets)
+    assert backend.last_path == "native-fallback"
+
+    bad = [sets[0], SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[1], M0)]
+    assert not backend.verify_signature_sets(bad)
+    assert backend.last_path == "native-fallback"
