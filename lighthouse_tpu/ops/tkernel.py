@@ -300,33 +300,80 @@ def double_t(a):
     return add_t(a, a)
 
 
+_GROUP = 8  # conv limb-group size (one sublane tile)
+_GROUP_LOWMEM = 2  # smaller windows where VMEM is tight (lowmem kernels)
+
+
 def mont_mul_t(a, b):
     """Montgomery product on the transposed layout; broadcast over leading
-    axes. Schoolbook conv + CIOS fold + carry, all as scan-with-roll so
-    the traced graph stays compact (see _carry_norm note); this is the
-    classic limb.mont_mul schedule with the limb axis moved to -2."""
+    axes. Grouped static schoolbook conv + CIOS fold-with-roll + carry.
+
+    The conv processes limbs in static groups: the grp shifted-b
+    operands are materialized once and each group touches one
+    (48+grp)-row window — far less data movement than the original
+    per-limb rotate-by-concat loop (measured v5e: the engine is
+    VMEM-bandwidth/instruction bound on the rolls). Products with a
+    lane-1 constant operand keep the roll form: their operand broadcast
+    would need a combined sublane+lane broadcast Mosaic does not
+    implement. The fold keeps the roll form either way: its per-limb m
+    chain is sequential by construction (CIOS)."""
+    lanes_match = a.shape[-1] == b.shape[-1]  # BEFORE broadcasting
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
     p_col = _c("P")
-    zero_rows = jnp.zeros((*shape[:-2], N_LIMBS, shape[-1]), jnp.int32)
-    b96 = jnp.concatenate([b, jnp.zeros_like(b)], axis=-2)
 
-    def conv_step(_, carry):
-        t, a_buf, b_buf = carry
-        t = t + b_buf * a_buf[..., 0:1, :]
-        a_buf = jnp.concatenate(
-            [a_buf[..., 1:, :], a_buf[..., :1, :]], axis=-2
-        )
-        b_buf = jnp.concatenate(
-            [b_buf[..., -1:, :], b_buf[..., :-1, :]], axis=-2
-        )
-        return (t, a_buf, b_buf)
+    if lanes_match and shape[-1] != 1:
+        grp = _GROUP_LOWMEM if _lowmem() else _GROUP
+        assert N_LIMBS % grp == 0, "conv group must divide the limb count"
+        zrow = jnp.zeros_like(b[..., :1, :])
 
-    t, _, _ = jax.lax.fori_loop(
-        0, N_LIMBS, conv_step,
-        (jnp.concatenate([zero_rows, zero_rows], axis=-2), a, b96),
-    )
+        def b_shift(k):
+            parts = []
+            if k:
+                parts.append(
+                    jnp.broadcast_to(zrow, (*shape[:-2], k, shape[-1]))
+                )
+            parts.append(b)
+            parts.append(jnp.broadcast_to(  # grp-k >= 1 always
+                zrow, (*shape[:-2], grp - k, shape[-1])
+            ))
+            return jnp.concatenate(parts, axis=-2)
+
+        b_sh = [b_shift(k) for k in range(grp)]
+
+        t = jnp.zeros((*shape[:-2], 2 * N_LIMBS, shape[-1]), jnp.int32)
+        W = N_LIMBS + grp
+        for g in range(N_LIMBS // grp):                  # static groups
+            lo = g * grp
+            seg = t[..., lo : lo + W, :]
+            for k in range(grp):                         # static sub-steps
+                seg = seg + b_sh[k] * a[..., lo + k : lo + k + 1, :]
+            parts = [seg]
+            if lo:  # Mosaic rejects zero-sized slices in concats
+                parts.insert(0, t[..., :lo, :])
+            if lo + W < 2 * N_LIMBS:
+                parts.append(t[..., lo + W :, :])
+            t = jnp.concatenate(parts, axis=-2)
+    else:
+        zero_rows = jnp.zeros((*shape[:-2], N_LIMBS, shape[-1]), jnp.int32)
+        b96 = jnp.concatenate([b, jnp.zeros_like(b)], axis=-2)
+
+        def conv_step(_, carry):
+            t, a_buf, b_buf = carry
+            t = t + b_buf * a_buf[..., 0:1, :]
+            a_buf = jnp.concatenate(
+                [a_buf[..., 1:, :], a_buf[..., :1, :]], axis=-2
+            )
+            b_buf = jnp.concatenate(
+                [b_buf[..., -1:, :], b_buf[..., :-1, :]], axis=-2
+            )
+            return (t, a_buf, b_buf)
+
+        t, _, _ = jax.lax.fori_loop(
+            0, N_LIMBS, conv_step,
+            (jnp.concatenate([zero_rows, zero_rows], axis=-2), a, b96),
+        )
 
     def fold_step(_, t):
         m = (t[..., 0, :] * NINV8) & LIMB_MASK

@@ -191,8 +191,9 @@ def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, out_ref):
     positions among 63 doublings, instead of a uniform 64-step
     compute-both-and-select ladder (tkernel_pairing.segmented_x_walk —
     the Miller loop's segmentation). Q is on-curve by deserialization;
-    infinity passes (pt_subgroup_check semantics)."""
-    with tk.bound_consts(consts_ref[:]):
+    infinity passes (pt_subgroup_check semantics). lowmem: the grouped
+    -conv windows put the 256-lane body 78K over the VMEM limit."""
+    with tk.bound_consts(consts_ref[:], lowmem=True):
         F = tk.fp2_ops_t()
         x, y = x_ref[:], y_ref[:]
         inf = inf_ref[0, :] != 0

@@ -183,7 +183,9 @@ def _sswu_iso_kernel(u_ref, ebits_ref, consts_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _sswu_iso_t(u, interpret: bool):
     t = u.shape[-1]
-    tile = _tile_for(t, 256)
+    # tile cap 128 (was 256): the grouped-conv engine's window buffers
+    # put the 256-lane body 2.7M over the 16M scoped-VMEM limit.
+    tile = _tile_for(t, 128)
     t_pad = -(-t // tile) * tile
     u = _pad_lanes(u, t_pad)
     in_specs = _specs(
@@ -235,7 +237,9 @@ def _cofactor_kernel(pt_ref, consts_ref, out_ref):
     (doubling/inverse/infinity cases selected), so pipeline points and
     padding lanes are safe; parity with the classic path is pinned on
     the affine outputs (tests/test_htc.py)."""
-    with tk.bound_consts(consts_ref[:]):
+    # lowmem: the grouped-conv window buffers put this body 628K over
+    # the 16M scoped-VMEM limit at full group size.
+    with tk.bound_consts(consts_ref[:], lowmem=True):
         F = tk.fp2_ops_t()
         Q = (pt_ref[0], pt_ref[1], pt_ref[2])
 
