@@ -34,6 +34,67 @@ import time
 
 import numpy as np
 
+def _probe_backend(attempts: int = 3, timeout: int = 300) -> str | None:
+    """Initialize the configured backend in a THROWAWAY subprocess.
+
+    A backend-init failure inside this process would poison jax's backend
+    cache for the rest of the run; probing in a child keeps the parent
+    clean and allows retries against a transiently-down TPU tunnel
+    (BENCH_r03.json: one `Unable to initialize backend 'axon'` cost round
+    3 its official perf number). Returns the platform string or None.
+    """
+    import subprocess
+
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            sys.stderr.write(
+                f"bench: backend probe attempt {i + 1}/{attempts} failed "
+                f"(rc={out.returncode}): {out.stderr[-300:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench: backend probe attempt {i + 1}/{attempts} timed out\n"
+            )
+        if i + 1 < attempts:
+            time.sleep(10 * (i + 1))
+    return None
+
+
+# Set once the real headline JSON line is printed: the watchdog/catch-all
+# must never append a second, contradictory line after a successful run
+# (e.g. a hang or exception in TPU-runtime teardown).
+_HEADLINE_EMITTED = False
+# The exit code a deliberate sys.exit chose before any teardown hang —
+# the watchdog must not overwrite a loud rc=1 with rc=0.
+_INTENDED_RC = 0
+
+
+def _emit_fallback(err: str) -> None:
+    """The always-parseable last-resort JSON line (metric matches the
+    mode actually being run, so a slot-mode failure doesn't record a
+    bogus 0.0 under the batch metric)."""
+    global _HEADLINE_EMITTED
+    if _HEADLINE_EMITTED:
+        return
+    slot = os.environ.get("BENCH_MODE") == "slot" or "--slot" in sys.argv
+    print(json.dumps({
+        "metric": ("full_slot_attester_verifications_per_sec" if slot
+                   else "bls_sets_verified_per_sec"),
+        "value": 0.0,
+        "unit": "attester-signatures/sec" if slot else "sets/sec",
+        "vs_baseline": 0.0,
+        "error": err[:400],
+    }), flush=True)
+    _HEADLINE_EMITTED = True
+
 
 def slot_mode() -> None:
     """BASELINE config #5: a full slot at registry scale.
@@ -170,7 +231,9 @@ def slot_mode() -> None:
             "pubkey_objects": "table-resident (deserialization at import)",
             "device": jax.devices()[0].platform,
         },
-    }))
+    }), flush=True)
+    global _HEADLINE_EMITTED
+    _HEADLINE_EMITTED = True
 
 
 def _vs_target(e2e_rate: float, native_rate: float | None, detail: dict) -> float:
@@ -357,6 +420,7 @@ def main() -> None:
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
     import jax.numpy as jnp
 
     from lighthouse_tpu.crypto.bls.api import (
@@ -430,9 +494,12 @@ def main() -> None:
     bad_args[2] = (jnp.asarray(sx), jnp.asarray(bad_sy))
     bad = bool(_verify(*bad_args))
     if not ok or (S > 1 and bad):
+        global _HEADLINE_EMITTED, _INTENDED_RC
         print(json.dumps({"metric": "bls_sets_verified_per_sec", "value": 0.0,
                           "unit": "sets/sec", "vs_baseline": 0.0,
-                          "error": "exactness gate failed"}))
+                          "error": "exactness gate failed"}), flush=True)
+        _HEADLINE_EMITTED = True
+        _INTENDED_RC = 1
         sys.exit(1)
 
     # --- timed: device-only -------------------------------------------------
@@ -517,11 +584,64 @@ def main() -> None:
         "vs_baseline": round(e2e_rate / base, 3),
         "vs_target": vs_target,
         "detail": detail,
-    }))
+    }), flush=True)
+    global _HEADLINE_EMITTED
+    _HEADLINE_EMITTED = True
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE") == "slot" or "--slot" in sys.argv:
-        slot_mode()
-    else:
-        main()
+    # The driver must ALWAYS get a parseable JSON line from this script
+    # (VERDICT r3 item 1a). Two backstops: a watchdog alarm that fires
+    # before any plausible driver timeout, and a catch-all that converts
+    # an escaping exception into an error line with rc=0. A deliberate
+    # sys.exit (the exactness gate's rc=1 on a WRONG verifier) passes
+    # through — that one should be loud.
+    import signal
+
+    def _watchdog(signum, frame):
+        _emit_fallback("bench watchdog timeout")
+        sys.stdout.flush()
+        os._exit(_INTENDED_RC)
+
+    # High enough to clear any healthy cold-cache TPU run (2-3 fused
+    # compiles at 10-25 min each PLUS up to ~15 min of probe retries);
+    # its job is converting an infinite hang into a line, not bounding
+    # normal variance.
+    _budget = int(os.environ.get("BENCH_WATCHDOG_SECS", "7200"))
+    if _budget > 0 and hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, _watchdog)
+        signal.alarm(_budget)
+
+    try:
+        # Probe the configured backend in a subprocess BEFORE this process
+        # touches it (covers BOTH modes — round 3 lost its official number
+        # to one transient 'axon' init failure). On failure: error line,
+        # exit 0. No CPU fallback run — a cold XLA:CPU compile of the
+        # pairing program costs 30+ min on this 1-core host, which would
+        # just trade a crash for a timeout.
+        if _probe_backend() is None:
+            _emit_fallback("tpu-unavailable: backend init failed after retries")
+            sys.exit(0)
+        if os.environ.get("BENCH_MODE") == "slot" or "--slot" in sys.argv:
+            slot_mode()
+        else:
+            main()
+    except SystemExit:
+        raise
+    except AssertionError as e:
+        # Correctness gates (exactness/table spot checks) are asserts:
+        # a WRONG verifier stays loud — parseable line, but rc=1.
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_fallback(f"correctness gate failed: {e}")
+        _INTENDED_RC = 1
+        sys.exit(1)
+    except KeyboardInterrupt:
+        raise  # an operator abort must stay distinguishable from a result
+    except BaseException as e:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_fallback(f"{type(e).__name__}: {e}")
+        sys.exit(0)

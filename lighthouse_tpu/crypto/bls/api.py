@@ -192,6 +192,12 @@ class AggregateSignature:
             return False
         if self.point.infinity:
             return False
+        # Infinity pubkeys contribute Fp12 one and would pass vacuously;
+        # the device (jax_backend.aggregate_verify_device) and native
+        # (lhbls_aggregate_verify) backends both reject them — keep the
+        # host oracle in agreement (ADVICE r3).
+        if any(pk.point.infinity for pk in pubkeys):
+            return False
         if not g2_subgroup_check(self.point):
             return False
         f = miller_loop(g1_generator().neg(), self.point)

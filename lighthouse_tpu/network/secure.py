@@ -256,6 +256,18 @@ class HandshakeError(ConnectionError):
     pass
 
 
+def _dh(sk: bytes, pk: bytes) -> bytes:
+    """x25519 with the RFC 7748 §6.1 all-zero output check.
+
+    A low-order / small-subgroup remote point maps every secret to the
+    same shared secret; rejecting the all-zero output keeps such points
+    out of the key schedule (ADVICE r3)."""
+    out = x25519(sk, pk)
+    if out == bytes(32):
+        raise ValueError("all-zero x25519 shared secret (low-order point)")
+    return out
+
+
 def _send(sock, data: bytes) -> None:
     sock.sendall(struct.pack(">H", len(data)) + data)
 
@@ -285,14 +297,14 @@ def handshake(sock, recv_exact, static_sk: bytes, *, initiator: bool,
             # <- e, ee, s, es
             re = _recv(sock, recv_exact)
             sym.mix_hash(re)
-            sym.mix_key(x25519(e_sk, re))
+            sym.mix_key(_dh(e_sk, re))
             ct_rs = _recv(sock, recv_exact)
             rs = sym.dec(ct_rs)
-            sym.mix_key(x25519(e_sk, rs))
+            sym.mix_key(_dh(e_sk, rs))
             # -> s, se
             ct_s = sym.enc(s_pub)
             _send(sock, ct_s)
-            sym.mix_key(x25519(s_sk, re))
+            sym.mix_key(_dh(s_sk, re))
             k1, k2 = _hkdf2(sym.ck, b"")
             send_k, recv_k = k1, k2
         else:
@@ -302,14 +314,14 @@ def handshake(sock, recv_exact, static_sk: bytes, *, initiator: bool,
             # -> e, ee, s, es
             sym.mix_hash(e_pub)
             _send(sock, e_pub)
-            sym.mix_key(x25519(e_sk, re))
+            sym.mix_key(_dh(e_sk, re))
             ct_s = sym.enc(s_pub)
             _send(sock, ct_s)
-            sym.mix_key(x25519(s_sk, re))
+            sym.mix_key(_dh(s_sk, re))
             # <- s, se
             ct_rs = _recv(sock, recv_exact)
             rs = sym.dec(ct_rs)
-            sym.mix_key(x25519(e_sk, rs))
+            sym.mix_key(_dh(e_sk, rs))
             k1, k2 = _hkdf2(sym.ck, b"")
             send_k, recv_k = k2, k1
     except (ValueError, struct.error) as e:

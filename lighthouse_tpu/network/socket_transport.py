@@ -38,6 +38,7 @@ per-direction counter nonce. yamux-style muxing is still not modeled
 
 from __future__ import annotations
 
+import hashlib
 import json
 import socket
 import struct
@@ -102,6 +103,15 @@ class _Conn:
         self._responses: dict[int, tuple[list, threading.Event, list]] = {}
 
     def send(self, ftype: int, payload: bytes) -> None:
+        # Same plaintext-frame limit both modes: enforce at the SENDER so
+        # an oversize frame errors here instead of tearing down the
+        # connection at the receiver; the receiver accepts the 17-byte
+        # AEAD overhead (1 type byte folded into plaintext + 16 tag) on
+        # top (ADVICE r3).
+        if 1 + len(payload) > _MAX_FRAME:
+            raise ValueError(
+                f"frame payload {len(payload)}B exceeds limit {_MAX_FRAME - 1}"
+            )
         with self.wlock:
             if self.boxes is not None:
                 ct = self.boxes[0].encrypt(bytes([ftype]) + payload)
@@ -112,7 +122,7 @@ class _Conn:
     def recv_frame(self) -> tuple[int, bytes]:
         if self.boxes is not None:
             (length,) = struct.unpack(">I", _recv_exact(self.sock, 4))
-            if not 17 <= length <= _MAX_FRAME:
+            if not 17 <= length <= _MAX_FRAME + 16:
                 raise ConnectionError(f"bad frame length {length}")
             try:
                 body = self.boxes[1].decrypt(_recv_exact(self.sock, length))
@@ -193,10 +203,15 @@ class _Conn:
         status: list = []
         self._responses[req_id] = (chunks, done, status)
         pb = proto.encode()
-        self.send(
-            _REQ,
-            struct.pack(">Q", req_id) + struct.pack(">H", len(pb)) + pb + wire,
-        )
+        try:
+            self.send(
+                _REQ,
+                struct.pack(">Q", req_id)
+                + struct.pack(">H", len(pb)) + pb + wire,
+            )
+        except Exception:
+            self._responses.pop(req_id, None)  # oversize frame, dead socket
+            raise
         if not done.wait(timeout):
             self._responses.pop(req_id, None)
             raise ConnectionError(f"request {proto} timed out")
@@ -459,7 +474,6 @@ def derived_peer_id(bls_pub: bytes) -> str:
     """Self-certifying peer id from the identity key (discv5 derives the
     node id from the ENR pubkey the same way): a peer id in this form
     cannot be claimed without the matching secret key."""
-    import hashlib
 
     return "nid-" + hashlib.sha256(bls_pub).hexdigest()[:16]
 
@@ -503,10 +517,19 @@ class UdpDiscoveryServer:
     ``require_signed=True`` additionally rejects unsigned records."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 require_signed: bool = False):
+                 require_signed: bool = False,
+                 ping_rate_limit: float = 20.0):
         self.records: dict[str, dict] = {}
         self.require_signed = require_signed
         self.rejected = 0
+        self.rate_limited = 0
+        # A BLS pairing per unauthenticated datagram is a DoS lever
+        # (ADVICE r3): token-bucket PINGs per source IP and memoize
+        # (record-body, sig) verification results.
+        self._ping_rate = ping_rate_limit
+        self._buckets: dict[str, tuple[float, float]] = {}  # key -> (tokens, ts)
+        self._last_sweep = 0.0
+        self._verify_cache: dict[bytes, bool] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
         self.host, self.port = self._sock.getsockname()
@@ -520,12 +543,60 @@ class UdpDiscoveryServer:
         except OSError:
             pass
 
+    def _allow_ping(self, ip: str, op: str = "ping") -> bool:
+        """Token bucket: ``ping_rate_limit`` ops/s per (source IP, op) —
+        PING and FIND budgets are separate so one op class can't starve
+        the other for peers sharing a NAT. Burst up to one second's
+        worth (capacity floored at 1 so sub-1/s rates still admit one
+        once refilled)."""
+        if self._ping_rate <= 0:
+            return True
+        now = time.monotonic()
+        cap = max(1.0, self._ping_rate)
+        key = f"{op}:{ip}"
+        tokens, last = self._buckets.get(key, (cap, now))
+        tokens = min(cap, tokens + (now - last) * self._ping_rate)
+        allowed = tokens >= 1.0
+        if allowed:
+            tokens -= 1.0
+        if key not in self._buckets and len(self._buckets) >= 4096:
+            # Bound state under an address spray WITHOUT resetting active
+            # limiters (a clear() would re-grant a flooder its burst):
+            # evict entries idle >60s — at most once a second, so the
+            # sweep itself can't become a per-packet O(n) cost under the
+            # very flood it defends against; if the table is still full
+            # of live limiters, FAIL CLOSED for untracked sources —
+            # dropping new registrants while under an address-spray
+            # flood beats letting the flood bypass the limiter entirely.
+            if now - self._last_sweep >= 1.0:
+                self._last_sweep = now
+                cutoff = now - 60.0
+                for k in [k for k, (_, l) in self._buckets.items()
+                          if l < cutoff]:
+                    del self._buckets[k]
+            if len(self._buckets) >= 4096:
+                return False
+        self._buckets[key] = (tokens, now)
+        return allowed
+
+    def _verify_cached(self, rec: dict) -> bool:
+        key = hashlib.sha256(
+            json.dumps(rec, sort_keys=True).encode()
+        ).digest()
+        hit = self._verify_cache.get(key)
+        if hit is None:
+            hit = verify_record(rec)
+            if len(self._verify_cache) > 4096:
+                self._verify_cache.clear()
+            self._verify_cache[key] = hit
+        return hit
+
     def _admit(self, rec) -> bool:
         if not isinstance(rec, dict) or "peer_id" not in rec:
             return False
         prev = self.records.get(rec["peer_id"])
         if "sig" in rec or "bls_pub" in rec:
-            if not verify_record(rec):
+            if not self._verify_cached(rec):
                 return False
             # Identity binding (prevents registering an arbitrary
             # peer_id under a fresh key): either the peer id is derived
@@ -554,6 +625,13 @@ class UdpDiscoveryServer:
             except ValueError:
                 continue
             if msg.get("op") == "ping" and "record" in msg:
+                if not self._allow_ping(addr[0], "ping"):
+                    # Denied BEFORE any BLS verification (the cost the
+                    # limiter guards); an explicit reply so a legitimate
+                    # client sees "denied", not a 2s timeout.
+                    self.rate_limited += 1
+                    self._sock.sendto(b'{"op":"slow_down"}', addr)
+                    continue
                 rec = msg["record"]
                 if self._admit(rec):
                     self.records[rec["peer_id"]] = rec
@@ -562,6 +640,12 @@ class UdpDiscoveryServer:
                     self.rejected += 1
                     self._sock.sendto(b'{"op":"nack"}', addr)
             elif msg.get("op") == "find":
+                # FIND reflects the whole record set — a UDP amplification
+                # lever from spoofed sources; own per-IP budget.
+                if not self._allow_ping(addr[0], "find"):
+                    self.rate_limited += 1
+                    self._sock.sendto(b'{"op":"slow_down"}', addr)
+                    continue
                 out = json.dumps(
                     {"op": "nodes", "records": list(self.records.values())}
                 ).encode()
@@ -599,14 +683,20 @@ def udp_find(boot: tuple[str, int], timeout: float = 2.0) -> list[dict]:
 
 
 def discover_and_connect(peer: SocketPeer, boot: tuple[str, int],
-                         identity_sk=None) -> int:
+                         identity_sk=None, *,
+                         allow_unpinned: bool = False) -> int:
     """Register ourselves, then dial every other advertised node.
 
     With ``identity_sk`` (a BLS SecretKey) the record is signed and
     includes our transport static key; when dialing, signed records are
     verified and their 'xpub' pinned into the handshake — an
     impersonating registry entry can then neither register (bad sig)
-    nor survive the handshake (static mismatch)."""
+    nor survive the handshake (static mismatch).
+
+    An ENCRYPTED dialer refuses unsigned/unpinnable records by default —
+    dialing one is trust-on-first-use and an attacker who registers
+    first MITMs the stream (ADVICE r3). ``allow_unpinned=True`` restores
+    the old behaviour for closed test networks."""
     record = {"peer_id": peer.peer_id, "host": peer.host, "port": peer.port}
     if peer.static_pub is not None:
         record["xpub"] = peer.static_pub.hex()
@@ -625,6 +715,8 @@ def discover_and_connect(peer: SocketPeer, boot: tuple[str, int],
                 continue
             if "xpub" in rec:
                 pin = bytes.fromhex(rec["xpub"])
+        if pin is None and peer.static_pub is not None and not allow_unpinned:
+            continue  # encrypted dialer, unpinnable record: skip (TOFU MITM)
         try:
             peer.connect(rec["host"], int(rec["port"]), expected_static=pin)
             n += 1

@@ -146,11 +146,12 @@ def _accum_t(gx, gy, valid, interpret: bool):
         pl.BlockSpec((RB, 2, N_LIMBS, _LANES), lambda r: (r, 0, 0, 0)),
         pl.BlockSpec((RB, 1, _LANES), lambda r: (r, 0, 0)),
         pl.BlockSpec((tk.N_CONSTS, N_LIMBS, 1), lambda r: (0, 0, 0)),
+        pl.BlockSpec((tk.N_MONT_ROWS, N_LIMBS), lambda r: (0, 0)),
     ]
     out_spec = pl.BlockSpec((3, 2, N_LIMBS, _LANES), lambda r: (0, 0, 0, 0))
 
-    def kernel(x_ref, y_ref, v_ref, c_ref, out_ref):
-        with tk.bound_consts(c_ref[:]):
+    def kernel(x_ref, y_ref, v_ref, c_ref, mont_ref, out_ref):
+        with tk.bound_consts(c_ref[:], mont=mont_ref[:]):
             F = tk.fp2_ops_t()
             r = pl.program_id(0)
 
@@ -177,10 +178,10 @@ def _accum_t(gx, gy, valid, interpret: bool):
         in_specs=in_specs,
         out_specs=out_spec,
         interpret=interpret,
-    )(gx, gy, valid, jnp.asarray(tk.CONSTS_NP))
+    )(gx, gy, valid, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
 
 
-def _tree_kernel(b_ref, consts_ref, out_ref):
+def _tree_kernel(b_ref, consts_ref, mont_ref, out_ref):
     """Weighted bucket reduction at full 256-lane width.
 
     Lanes are digit-major (lane = (digit-1)*16 + w, lanes >= 240
@@ -190,7 +191,7 @@ def _tree_kernel(b_ref, consts_ref, out_ref):
     concat shifts; leading-batch tiny-lane layouts do NOT lower
     ('Not implemented: Sublane broadcast'), hence this formulation.
     """
-    with tk.bound_consts(consts_ref[:]):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
         F = tk.fp2_ops_t()
         P = (b_ref[0], b_ref[1], b_ref[2])
 
@@ -208,14 +209,14 @@ def _tree_kernel(b_ref, consts_ref, out_ref):
         out_ref[0], out_ref[1], out_ref[2] = P
 
 
-def _horner_kernel(t_ref, consts_ref, out_ref):
+def _horner_kernel(t_ref, consts_ref, mont_ref, out_ref):
     """sum_w 16^w * T[w] -> lane 0.
 
     buf holds T ROTATED so lane 0 is the current window; per fori step:
     4 doublings + 1 masked addition + rotate-right-by-one (rotation,
     not shift: the next window must wrap back into lane 0).
     """
-    with tk.bound_consts(consts_ref[:]):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
         F = tk.fp2_ops_t()
         T = (t_ref[0], t_ref[1], t_ref[2])
         lanes = T[0].shape[-1]
@@ -262,6 +263,7 @@ def _f3_call(kernel, operand, interpret: bool):
     in_specs = [
         pl.BlockSpec((3, 2, N_LIMBS, _LANES), lambda: (0, 0, 0, 0)),
         pl.BlockSpec((tk.N_CONSTS, N_LIMBS, 1), lambda: (0, 0, 0)),
+        pl.BlockSpec((tk.N_MONT_ROWS, N_LIMBS), lambda: (0, 0)),
     ]
     return pl.pallas_call(
         kernel,
@@ -269,7 +271,7 @@ def _f3_call(kernel, operand, interpret: bool):
         in_specs=in_specs,
         out_specs=pl.BlockSpec((3, 2, N_LIMBS, _LANES), lambda: (0, 0, 0, 0)),
         interpret=interpret,
-    )(operand, jnp.asarray(tk.CONSTS_NP))
+    )(operand, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

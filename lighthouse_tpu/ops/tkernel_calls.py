@@ -75,8 +75,8 @@ def _specs(shapes, tile):
 
 
 def _scalar_mul_kernel(g2: bool):
-    def kernel(x_ref, y_ref, inf_ref, bits_ref, consts_ref, out_ref):
-        with tk.bound_consts(consts_ref[:]):
+    def kernel(x_ref, y_ref, inf_ref, bits_ref, consts_ref, mont_ref, out_ref):
+        with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
             F = tk.fp2_ops_t() if g2 else tk.fp_ops_t()
             x, y = x_ref[:], y_ref[:]
             inf = inf_ref[0, :] != 0
@@ -110,7 +110,8 @@ def _scalar_mul_t(x, y, inf, bits, *, g2: bool, interpret: bool):
     coord = (2, N_LIMBS) if g2 else (N_LIMBS,)
     in_specs = _specs(
         [(coord, True), (coord, True), ((1,), True),
-         ((bits.shape[0],), True), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((bits.shape[0],), True), ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out_spec = _specs([((3, *coord), True)], tile)[0]
@@ -121,7 +122,7 @@ def _scalar_mul_t(x, y, inf, bits, *, g2: bool, interpret: bool):
         in_specs=in_specs,
         out_specs=out_spec,
         interpret=interpret,
-    )(x, y, inf, bits, jnp.asarray(tk.CONSTS_NP))
+    )(x, y, inf, bits, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
 
@@ -136,8 +137,8 @@ def scalar_mul_g2_t(x, y, inf, bits):
 # ---------------------------------------------------------- subgroup check
 
 
-def _subgroup_kernel(x_ref, y_ref, inf_ref, obits_ref, consts_ref, out_ref):
-    with tk.bound_consts(consts_ref[:]):
+def _subgroup_kernel(x_ref, y_ref, inf_ref, obits_ref, consts_ref, mont_ref, out_ref):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
         F = tk.fp2_ops_t()
         x, y = x_ref[:], y_ref[:]
         inf = inf_ref[0, :] != 0
@@ -164,7 +165,8 @@ def _subgroup_check_g2(x, y, inf, interpret: bool):
     x, y, inf = (_pad_lanes(v, t_pad) for v in (x, y, inf))
     in_specs = _specs(
         [((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
-         ((ORDER_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((ORDER_NBITS, 1), False), ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -174,7 +176,7 @@ def _subgroup_check_g2(x, y, inf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((1,), True)], tile)[0],
         interpret=interpret,
-    )(x, y, inf, _col(ORDER_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    )(x, y, inf, _col(ORDER_BITS_NP), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, :t] != 0
 
 
@@ -184,7 +186,7 @@ def subgroup_check_g2_t(x, y, inf):
     return _subgroup_check_g2(x, y, inf, _interpret())
 
 
-def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, out_ref):
+def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, mont_ref, out_ref):
     """psi(Q) == [x_bls]Q (Bowe's criterion) with the x-chain laid out by
     |x|'s STATIC bit pattern: the leading set bit initializes the
     accumulator and the remaining 5 appear as mixed adds at their exact
@@ -193,7 +195,7 @@ def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, out_ref):
     the Miller loop's segmentation). Q is on-curve by deserialization;
     infinity passes (pt_subgroup_check semantics). lowmem: the grouped
     -conv windows put the 256-lane body 78K over the VMEM limit."""
-    with tk.bound_consts(consts_ref[:], lowmem=True):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
         F = tk.fp2_ops_t()
         x, y = x_ref[:], y_ref[:]
         inf = inf_ref[0, :] != 0
@@ -230,7 +232,8 @@ def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
     x, y, inf = (_pad_lanes(v, t_pad) for v in (x, y, inf))
     in_specs = _specs(
         [((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
-         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -240,7 +243,7 @@ def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((1,), True)], tile)[0],
         interpret=interpret,
-    )(x, y, inf, jnp.asarray(tk.CONSTS_NP))
+    )(x, y, inf, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, :t] != 0
 
 
@@ -254,8 +257,8 @@ def subgroup_check_g2_fast_t(x, y, inf):
 
 
 def _to_affine_kernel(g2: bool):
-    def kernel(pt_ref, pinv_ref, consts_ref, out_ref, inf_ref):
-        with tk.bound_consts(consts_ref[:], pinv_bits=pinv_ref):
+    def kernel(pt_ref, pinv_ref, consts_ref, mont_ref, out_ref, inf_ref):
+        with tk.bound_consts(consts_ref[:], mont=mont_ref[:], pinv_bits=pinv_ref):
             F = tk.fp2_ops_t() if g2 else tk.fp_ops_t()
             X, Y, Z = pt_ref[0], pt_ref[1], pt_ref[2]
             zi = F.inv(Z)
@@ -279,7 +282,8 @@ def _to_affine_t(P, *, g2: bool, interpret: bool):
     coord = (2, N_LIMBS) if g2 else (N_LIMBS,)
     in_specs = _specs(
         [((3, *coord), True), ((tk.PINV_NBITS, 1), False),
-         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out_specs = _specs([((2, *coord), True), ((1,), True)], tile)
@@ -293,7 +297,7 @@ def _to_affine_t(P, *, g2: bool, interpret: bool):
         in_specs=in_specs,
         out_specs=tuple(out_specs),
         interpret=interpret,
-    )(stacked, _col(tk.PINV_BITS_NP), jnp.asarray(tk.CONSTS_NP))
+    )(stacked, _col(tk.PINV_BITS_NP), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, ..., :t], out[1, ..., :t], inf[0, :t] != 0
 
 
@@ -311,8 +315,8 @@ def to_affine_g2_t(P):
 
 
 def _miller_kernel(xp_ref, yp_ref, pinf_ref, xq_ref, yq_ref, qinf_ref,
-                   consts_ref, out_ref):
-    with tk.bound_consts(consts_ref[:], lowmem=True):
+                   consts_ref, mont_ref, out_ref):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
         f = tp.miller_loop_t(
             (xp_ref[:], yp_ref[:]),
             pinf_ref[0, :] != 0,
@@ -337,7 +341,8 @@ def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
     in_specs = _specs(
         [((N_LIMBS,), True), ((N_LIMBS,), True), ((1,), True),
          ((2, N_LIMBS), True), ((2, N_LIMBS), True), ((1,), True),
-         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -347,7 +352,7 @@ def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((2, 3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
-    )(xp, yp, pinf, xq, yq, qinf, jnp.asarray(tk.CONSTS_NP))
+    )(xp, yp, pinf, xq, yq, qinf, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[..., :t]
 
 
@@ -372,17 +377,17 @@ def miller_loop_kernel_t(p_aff, p_inf, q_aff, q_inf):
 _F12_SHAPE = (2, 3, 2, N_LIMBS)
 
 
-def _easy_exp_kernel(f_ref, pinv_ref, consts_ref, out_ref):
+def _easy_exp_kernel(f_ref, pinv_ref, consts_ref, mont_ref, out_ref):
     """f^(p^6-1) then ^(p^2+1) (pairing.py final_exponentiation easy)."""
-    with tk.bound_consts(consts_ref[:], pinv_bits=pinv_ref, lowmem=True):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:], pinv_bits=pinv_ref, lowmem=True):
         f = f_ref[:]
         g = tk.fp12_mul_t(tk.fp12_conj_t(f), tk.fp12_inv_t(f))
         out_ref[:] = tk.fp12_mul_t(tk.fp12_frobenius2_t(g), g)
 
 
 def _pow_kernel(xm1: bool):
-    def kernel(f_ref, consts_ref, out_ref):
-        with tk.bound_consts(consts_ref[:], lowmem=True):
+    def kernel(f_ref, consts_ref, mont_ref, out_ref):
+        with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
             f = f_ref[:]
             p = tp._cyc_pow_x_t(f)
             if xm1:  # f^(x-1) = f^x * conj(f)
@@ -393,8 +398,8 @@ def _pow_kernel(xm1: bool):
 
 
 def _comb_kernel(mode: str):
-    def kernel(u_ref, v_ref, consts_ref, out_ref):
-        with tk.bound_consts(consts_ref[:], lowmem=True):
+    def kernel(u_ref, v_ref, consts_ref, mont_ref, out_ref):
+        with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
             u, v = u_ref[:], v_ref[:]
             if mode == "b":        # u * frob(v)
                 out = tk.fp12_mul_t(u, tk.fp12_frobenius_t(v))
@@ -433,20 +438,21 @@ def _f12_call(kernel, operands, extra_specs, extras, t, interpret):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _final_exp_t(f, interpret: bool):
     t = f.shape[-1]
-    consts = jnp.asarray(tk.CONSTS_NP)
-    cs = [((tk.N_CONSTS, N_LIMBS, 1), False)]
+    consts = [jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP)]
+    cs = [((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)]
 
     def pow_(g, xm1):
-        return _f12_call(_pow_kernel(xm1), [g], cs, [consts], t, interpret)
+        return _f12_call(_pow_kernel(xm1), [g], cs, consts, t, interpret)
 
     def comb(u, v, mode):
-        return _f12_call(_comb_kernel(mode), [u, v], cs, [consts],
+        return _f12_call(_comb_kernel(mode), [u, v], cs, consts,
                          t, interpret)
 
     g = _f12_call(
         _easy_exp_kernel, [f],
         [((tk.PINV_NBITS, 1), False)] + cs,
-        [_col(tk.PINV_BITS_NP), consts], t, interpret,
+        [_col(tk.PINV_BITS_NP)] + consts, t, interpret,
     )
     a = pow_(pow_(g, True), True)
     b = comb(pow_(a, False), a, "b")

@@ -111,8 +111,8 @@ def _sqrt_ratio_t(u, v, ebits_ref):
 # --------------------------------------------------------- sswu + isogeny
 
 
-def _sswu_iso_kernel(u_ref, ebits_ref, consts_ref, out_ref):
-    with tk.bound_consts(consts_ref[:]):
+def _sswu_iso_kernel(u_ref, ebits_ref, consts_ref, mont_ref, out_ref):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
         u = u_ref[:]
         shape = u.shape
 
@@ -190,7 +190,8 @@ def _sswu_iso_t(u, interpret: bool):
     u = _pad_lanes(u, t_pad)
     in_specs = _specs(
         [((2, N_LIMBS), True), ((SQRT_RATIO_NBITS, 1), False),
-         ((tk.N_CONSTS, N_LIMBS, 1), False)],
+         ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -200,7 +201,7 @@ def _sswu_iso_t(u, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
-    )(u, _col(SQRT_RATIO_BITS), jnp.asarray(tk.CONSTS_NP))
+    )(u, _col(SQRT_RATIO_BITS), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
 
@@ -217,7 +218,7 @@ def _psi_t(P):
 
 
 
-def _cofactor_kernel(pt_ref, consts_ref, out_ref):
+def _cofactor_kernel(pt_ref, consts_ref, mont_ref, out_ref):
     """(x^2-x-1) Q + (x-1) ψ(Q) + ψ(ψ(2Q)) — htc.clear_cofactor fused,
     via two segmented |x|-walks instead of uniform bit-table chains.
 
@@ -239,7 +240,7 @@ def _cofactor_kernel(pt_ref, consts_ref, out_ref):
     the affine outputs (tests/test_htc.py)."""
     # lowmem: the grouped-conv window buffers put this body 628K over
     # the 16M scoped-VMEM limit at full group size.
-    with tk.bound_consts(consts_ref[:], lowmem=True):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
         F = tk.fp2_ops_t()
         Q = (pt_ref[0], pt_ref[1], pt_ref[2])
 
@@ -269,7 +270,8 @@ def _cofactor_t(P, interpret: bool):
     t_pad = -(-t // tile) * tile
     stacked = _pad_lanes(jnp.stack(P), t_pad)
     in_specs = _specs(
-        [((3, 2, N_LIMBS), True), ((tk.N_CONSTS, N_LIMBS, 1), False)],
+        [((3, 2, N_LIMBS), True), ((tk.N_CONSTS, N_LIMBS, 1), False),
+         ((tk.N_MONT_ROWS, N_LIMBS), False)],
         tile,
     )
     out = pl.pallas_call(
@@ -279,7 +281,7 @@ def _cofactor_t(P, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
-    )(stacked, jnp.asarray(tk.CONSTS_NP))
+    )(stacked, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
 

@@ -123,3 +123,19 @@ def test_signature_set_verify_single(keypairs, signatures):
     _, pks = keypairs
     assert SignatureSet.single_pubkey(signatures[1], pks[1], MSG).verify()
     assert not SignatureSet.single_pubkey(signatures[1], pks[0], MSG).verify()
+
+
+def test_aggregate_verify_rejects_infinity_pubkey(keypairs):
+    """An infinity pubkey contributes Fp12 one and would pass vacuously.
+    The device and native backends reject it; the host oracle must agree
+    (ADVICE r3 cross-backend divergence). Only reachable with a directly
+    constructed PublicKey — from_bytes already refuses infinity."""
+    from lighthouse_tpu.crypto.bls.curve import AffinePoint, g1_generator
+
+    sks, pks = keypairs
+    sig = AggregateSignature.aggregate([sks[0].sign(MSG), sks[1].sign(MSG2)])
+    assert sig.aggregate_verify([pks[0], pks[1]], [MSG, MSG2])
+
+    g = g1_generator()
+    inf_pk = PublicKey(AffinePoint.infinity_point(type(g.x), g.b))
+    assert not sig.aggregate_verify([pks[0], inf_pk], [MSG, MSG2])

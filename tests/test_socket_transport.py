@@ -74,7 +74,12 @@ def test_udp_discovery_roundtrip():
         )
         recs = udp_find((boot.host, boot.port))
         assert [r["peer_id"] for r in recs] == ["a"]
-        assert discover_and_connect(b, (boot.host, boot.port)) == 1
+        # encrypted dialer + unsigned (unpinnable) record: skipped by
+        # default (TOFU MITM hazard, ADVICE r3); opt in for closed nets
+        assert discover_and_connect(b, (boot.host, boot.port)) == 0
+        assert discover_and_connect(
+            b, (boot.host, boot.port), allow_unpinned=True
+        ) == 1
         time.sleep(0.05)
         assert "b" in a.connected_peers()
     finally:
@@ -302,5 +307,60 @@ def test_signed_discovery_records():
         assert boot.rejected >= 3
     finally:
         boot.close()
+        a.close()
+        b.close()
+
+
+def test_udp_ping_rate_limit():
+    """A spoofed-PING flood must not pin the bootnode on BLS pairings:
+    per-IP token bucket drops excess datagrams silently (ADVICE r3)."""
+    import json as _json
+    import socket as _socket
+
+    from lighthouse_tpu.network.socket_transport import UdpDiscoveryServer
+
+    boot = UdpDiscoveryServer(ping_rate_limit=5.0)
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        msg = _json.dumps(
+            {"op": "ping",
+             "record": {"peer_id": "flood", "host": "127.0.0.1", "port": 1}}
+        ).encode()
+        for _ in range(50):
+            sock.sendto(msg, (boot.host, boot.port))
+        deadline = time.time() + 2
+        while boot.rate_limited == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert boot.rate_limited > 0
+        assert "flood" in boot.records  # the in-budget pings still landed
+    finally:
+        sock.close()
+        boot.close()
+
+
+def test_frame_limit_enforced_at_sender():
+    """The plaintext frame limit is identical in both transport modes and
+    enforced at the SENDER — an oversize frame raises ValueError locally
+    instead of tearing down the connection at the receiver (ADVICE r3)."""
+    from lighthouse_tpu.network import socket_transport as st
+
+    a = st.SocketPeer("fl-a")
+    b = st.SocketPeer("fl-b")
+    old = st._MAX_FRAME
+    st._MAX_FRAME = 1 << 10
+    try:
+        b.connect(a.host, a.port)
+        deadline = time.time() + 5
+        while "fl-a" not in b.connected_peers() and time.time() < deadline:
+            time.sleep(0.02)
+        conn = b._conns["fl-a"]
+        with pytest.raises(ValueError):
+            conn.send(1, b"x" * (1 << 10))  # 1 + payload > limit
+        # a max-size payload still goes through intact
+        conn.send(1, b"y" * ((1 << 10) - 1))
+        time.sleep(0.1)
+        assert conn.alive
+    finally:
+        st._MAX_FRAME = old
         a.close()
         b.close()

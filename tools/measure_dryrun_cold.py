@@ -1,0 +1,38 @@
+"""Measure dryrun_multichip(8) cold wall time, emulating the driver host.
+
+Redirects the persistent compile cache to an empty temp dir so every XLA
+compile is cold (the committed .jax_cache doesn't AOT-load cross-machine —
+MULTICHIP_r03.json tail), then runs the gate exactly as the driver does.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+cold = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(prefix="cold_jax_cache_")
+
+import jax  # noqa: E402
+
+_orig_update = jax.config.update
+
+
+def _patched(name, val):
+    if name == "jax_compilation_cache_dir":
+        val = cold
+    _orig_update(name, val)
+
+
+jax.config.update = _patched
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import __graft_entry__  # noqa: E402
+
+t0 = time.time()
+__graft_entry__.dryrun_multichip(8)
+print(f"TOTAL COLD WALL: {time.time() - t0:.1f}s", flush=True)
