@@ -84,6 +84,16 @@ class NetworkService:
         self._subscribe_topics(subscribe_all_subnets)
         self._register_rpc()
         self.peer.on_gossip = self._on_gossip
+        # Score-driven mesh (SocketPeer transport): gossip topology is
+        # shaped by the SAME PeerManager scores RPC/gossip behaviors
+        # feed (reference: behaviour/gossipsub_scoring_parameters.rs).
+        if hasattr(self.peer, "score_fn"):
+            self.peer.score_fn = self.peer_manager.score
+            self.peer.on_mesh_violation = lambda pid: (
+                self.peer_manager.report_peer(
+                    pid, PeerAction.LOW_TOLERANCE_ERROR
+                )
+            )
 
     def discover_and_connect(self, limit: int = 16) -> int:
         """Discovery round: handshake not-yet-connected same-fork peers
@@ -329,5 +339,7 @@ class NetworkService:
         reprocess-queue work, then drain the processor. Returns events
         processed."""
         self.peer.deliver_pending()
+        if hasattr(self.peer, "maintain_mesh"):
+            self.peer.maintain_mesh()  # score-driven graft/prune heartbeat
         self.router.reprocess.tick(self.chain.current_slot())
         return self.processor.process_pending()
