@@ -157,3 +157,31 @@ def set_device_table(table: DevicePubkeyTable | None) -> None:
 
 def get_device_table() -> DevicePubkeyTable | None:
     return _TABLE
+
+
+def compressed_pubkeys(table: DevicePubkeyTable) -> np.ndarray:
+    """[n, 48] uint8 compressed pubkeys for the table's registry.
+
+    Converts the Montgomery-limb affine planes to standard-domain ints
+    host-side (one 384-bit mulmod per coordinate) and applies the
+    ZCash compression convention (flag bits + big-endian x). The cost
+    is ~seconds at n=1M — the one-time registry import price the
+    table-resident design pays instead of per-verification
+    deserialization (validator_pubkey_cache.rs analog)."""
+    from .crypto.bls.constants import P
+    from .ops import limb
+
+    n = len(table)
+    rinv = pow(limb.R_MONT, -1, P)
+    out = np.zeros((n, 48), np.uint8)
+    xb = table._host_x[:n].tobytes()
+    yb = table._host_y[:n].tobytes()
+    for i in range(n):
+        x = int.from_bytes(xb[i * 48:(i + 1) * 48], "little") * rinv % P
+        y = int.from_bytes(yb[i * 48:(i + 1) * 48], "little") * rinv % P
+        row = bytearray(x.to_bytes(48, "big"))
+        row[0] |= 0x80  # compressed flag
+        if y != 0 and 2 * y > P:
+            row[0] |= 0x20  # lexicographically-largest y
+        out[i] = np.frombuffer(bytes(row), np.uint8)
+    return out

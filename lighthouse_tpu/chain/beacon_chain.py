@@ -84,6 +84,7 @@ class BeaconChain:
         genesis_block,
         genesis_block_root: bytes,
         backend: str | None = None,
+        pubkey_cache: ValidatorPubkeyCache | None = None,
     ):
         self.spec = spec
         self.store = store
@@ -108,8 +109,11 @@ class BeaconChain:
         self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
 
         self.op_pool = OperationPool(spec)
-        self.pubkey_cache = ValidatorPubkeyCache.from_state(
-            genesis_state, store=store.db
+        # injectable for registry-scale startup (a device-table-backed
+        # LAZY cache skips 1M host decompressions; pubkey_cache.py)
+        self.pubkey_cache = (
+            pubkey_cache if pubkey_cache is not None
+            else ValidatorPubkeyCache.from_state(genesis_state, store=store.db)
         )
         self.shuffling_cache = ShufflingCache()
         self.snapshot_cache = SnapshotCache()
@@ -141,7 +145,8 @@ class BeaconChain:
     # ------------------------------------------------------------- factories
     @classmethod
     def from_genesis(
-        cls, store: HotColdDB, genesis_state, spec: ChainSpec, slot_clock, backend=None
+        cls, store: HotColdDB, genesis_state, spec: ChainSpec, slot_clock,
+        backend=None, pubkey_cache=None,
     ) -> "BeaconChain":
         t = spec_types(spec.preset)
         fork = state_fork_name(genesis_state)
@@ -153,7 +158,8 @@ class BeaconChain:
         store.put_block(block_root, signed)
         store.set_genesis_block_root(block_root)
         chain = cls(
-            spec, store, slot_clock, genesis_state, signed, block_root, backend
+            spec, store, slot_clock, genesis_state, signed, block_root,
+            backend, pubkey_cache=pubkey_cache,
         )
         chain.snapshot_cache.insert(block_root, genesis_state.copy())
         return chain
@@ -579,6 +585,107 @@ class BeaconChain:
                 results.append(VerifiedAttestation(att, indexed))
             else:
                 results.append(AttestationError("invalid attestation signature"))
+        return results
+
+    def batch_verify_aggregated_attestations_for_gossip(
+        self, signed_aggregates
+    ):
+        """Batch path for SignedAggregateAndProof gossip: every
+        aggregate's THREE signature sets (selection proof, aggregator,
+        aggregate) ride one device batch with poisoning bisection —
+        the aggregate twin of the unaggregated batch pipeline
+        (reference: attestation_verification/batch.rs:36-128
+        batch_verify_aggregated_attestations). Pre-verification checks
+        (dedup roots/aggregators, is_aggregator) keep the sequential
+        path's semantics exactly; an aggregate passes only if all three
+        of its sets verify."""
+        from ..common.timeout_lock import LockTimeout
+
+        candidates = []
+        try:
+            lock_ctx = self.pubkey_cache.lock.read()
+            lock_ctx.__enter__()
+        except LockTimeout:
+            err = AttestationError("pubkey cache lock timeout")
+            return [err for _ in signed_aggregates]
+        try:
+            state = self._head.state
+            get_pubkey = self.pubkey_cache.as_getter()
+            for sa in signed_aggregates:
+                try:
+                    message = sa.message
+                    aggregate = message.aggregate
+                    indexed, committee = self._gossip_attestation_checks(
+                        aggregate
+                    )
+                    epoch = int(aggregate.data.target.epoch)
+                    att_root = aggregate.hash_tree_root()
+                    # CHECK-only here; recording happens after the batch
+                    # verifies (like the unaggregated path) so an
+                    # invalid-signature copy cannot censor the valid
+                    # aggregate from an honest aggregator.
+                    if self.observed_aggregates.is_known_root(epoch, att_root):
+                        raise AttestationError("aggregate already known")
+                    aggregator_index = int(message.aggregator_index)
+                    if self.observed_aggregates.is_known_aggregator(
+                        epoch, aggregator_index
+                    ):
+                        raise AttestationError(
+                            "aggregator already seen this epoch"
+                        )
+                    if not self._is_aggregator(
+                        int(aggregate.data.slot),
+                        len(committee),
+                        bytes(message.selection_proof),
+                    ):
+                        raise AttestationError("validator is not an aggregator")
+                    three = [
+                        sigs.signed_aggregate_selection_proof_signature_set(
+                            state, get_pubkey, sa, self.spec
+                        ),
+                        sigs.signed_aggregate_signature_set(
+                            state, get_pubkey, sa, self.spec
+                        ),
+                        sigs.indexed_attestation_signature_set(
+                            state, get_pubkey, aggregate.signature, indexed,
+                            self.spec,
+                        ),
+                    ]
+                    candidates.append(
+                        (aggregate, indexed, three, epoch, att_root,
+                         aggregator_index, None)
+                    )
+                except (AttestationError, ValueError) as e:
+                    candidates.append((None, None, None, None, None, None, e))
+        finally:
+            lock_ctx.__exit__(None, None, None)
+
+        sets = [s for c in candidates if c[2] is not None for s in c[2]]
+        oks = iter(self._bisect_verify(sets))
+        results = []
+        for (aggregate, indexed, three, epoch, att_root, agg_idx,
+             err) in candidates:
+            if err is not None:
+                results.append(err)
+                continue
+            ok = all([next(oks), next(oks), next(oks)])  # no short-circuit:
+            # the iterator must advance exactly 3 per aggregate
+            if not ok:
+                results.append(
+                    AttestationError("invalid aggregate signature(s)")
+                )
+                continue
+            # Dedup AFTER verification (first VERIFIED copy wins —
+            # covers intra-batch duplicates too).
+            if self.observed_aggregates.observe_root(epoch, att_root):
+                results.append(AttestationError("aggregate already known"))
+                continue
+            if self.observed_aggregates.observe_aggregator(epoch, agg_idx):
+                results.append(
+                    AttestationError("aggregator already seen this epoch")
+                )
+                continue
+            results.append(VerifiedAttestation(aggregate, indexed))
         return results
 
     # Below this subtree size a failing batch verifies per-set (a batch

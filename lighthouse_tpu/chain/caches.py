@@ -133,6 +133,15 @@ class ObservedAggregates:
         self._aggregators[epoch].add(aggregator_index)
         return seen
 
+    # Check-only queries: batch verification dedups AFTER signature
+    # checks (an invalid copy must not censor the valid aggregate), so
+    # pre-checks may only LOOK, never record.
+    def is_known_root(self, epoch: int, att_root: bytes) -> bool:
+        return att_root in self._roots[epoch]
+
+    def is_known_aggregator(self, epoch: int, aggregator_index: int) -> bool:
+        return aggregator_index in self._aggregators[epoch]
+
     def prune(self, finalized_epoch: int) -> None:
         for m in (self._roots, self._aggregators):
             for e in [e for e in m if e < finalized_epoch]:

@@ -87,12 +87,56 @@ class ValidatorPubkeyCache:
         if self.device_table is not None and new:
             self.device_table.append_pubkeys([pk for _, pk in new])
 
+    @classmethod
+    def from_device_table(cls, table, compressed, store=None
+                          ) -> "ValidatorPubkeyCache":
+        """Registry-scale import: coordinates come from the DEVICE-BUILT
+        table (blsrt) and PublicKey objects materialize LAZILY on first
+        use — a 1M-validator registry costs zero per-key host
+        decompression at startup (the table-resident design; reference
+        decompresses every key once at import,
+        validator_pubkey_cache.rs:77-120). ``compressed`` is the
+        [n, 48] uint8 compressed-key array (blsrt.compressed_pubkeys);
+        the compressed->index map builds on first get_index call."""
+        cache = cls(store)
+        cache.pubkeys = [None] * len(table)
+        cache._lazy_table = table
+        cache._lazy_compressed = compressed
+        cache._indices_built = False
+        cache.device_table = table
+        return cache
+
+    def _materialize(self, index: int) -> PublicKey:
+        from ..ops.points import g1_from_dev
+
+        t = self._lazy_table
+        (pt,) = g1_from_dev(
+            t._host_x[index:index + 1].astype("int32"),
+            t._host_y[index:index + 1].astype("int32"),
+            [False],
+        )
+        pk = PublicKey(pt, bytes(self._lazy_compressed[index].tobytes()))
+        self.pubkeys[index] = pk
+        return pk
+
     def get(self, index: int) -> PublicKey | None:
         if 0 <= index < len(self.pubkeys):
-            return self.pubkeys[index]
+            pk = self.pubkeys[index]
+            if pk is None and getattr(self, "_lazy_table", None) is not None:
+                return self._materialize(index)
+            return pk
         return None
 
     def get_index(self, compressed: bytes) -> int | None:
+        # Flag-guarded (NOT dict truthiness: import_new_pubkeys may seed
+        # indices with post-genesis keys before the lazy registry build).
+        if (getattr(self, "_lazy_compressed", None) is not None
+                and not self._indices_built):
+            self._indices_built = True
+            for i in range(len(self._lazy_compressed)):
+                self.indices.setdefault(
+                    bytes(self._lazy_compressed[i].tobytes()), i
+                )
         return self.indices.get(bytes(compressed))
 
     def __len__(self) -> int:

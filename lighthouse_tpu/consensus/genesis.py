@@ -214,3 +214,75 @@ def interop_genesis_state(
     )
     state.genesis_time = genesis_time
     return state
+
+
+def scale_genesis_state(compressed_pubkeys, genesis_time: int,
+                        spec: ChainSpec):
+    """Registry-scale genesis WITHOUT deposit replay.
+
+    Installs validators directly from a compressed-pubkey array (the
+    device-built blsrt registry) — the 1M-validator startup path for
+    config #5 through the chain, where per-deposit processing and
+    per-key signature checks would dominate. Semantically the resulting
+    state matches initialize_beacon_state_from_eth1 with max-balance
+    pre-activated validators and no pending deposits (reference:
+    genesis.rs; the reference's interop tooling similarly installs
+    validators directly for scale tests, lcli/src/interop_genesis.rs)."""
+    from .types import Validator
+
+    p = spec.preset
+    t = spec_types(p)
+    n = len(compressed_pubkeys)
+
+    fork = Fork(
+        previous_version=spec.GENESIS_FORK_VERSION,
+        current_version=spec.GENESIS_FORK_VERSION,
+        epoch=GENESIS_EPOCH,
+    )
+    state = t.BeaconStatePhase0(
+        genesis_time=genesis_time,
+        fork=fork,
+        eth1_data=Eth1Data(
+            deposit_root=bytes(32), deposit_count=n, block_hash=bytes(32)
+        ),
+        latest_block_header=BeaconBlockHeader(
+            body_root=t.BeaconBlockBodyPhase0().hash_tree_root()
+        ),
+        randao_mixes=[bytes(32)] * p.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+    from .config import FAR_FUTURE_EPOCH
+
+    mx = p.MAX_EFFECTIVE_BALANCE
+    for i in range(n):
+        state.validators.append(Validator(
+            pubkey=bytes(compressed_pubkeys[i].tobytes()),
+            withdrawal_credentials=bytes(32),
+            effective_balance=mx,
+            slashed=False,
+            activation_eligibility_epoch=GENESIS_EPOCH,
+            activation_epoch=GENESIS_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        state.balances.append(mx)
+    # all advertised deposits are already applied: without this an
+    # empty-deposit block would fail process_operations' expected-
+    # deposit count (transition/block.py)
+    state.eth1_deposit_index = n
+    state.genesis_validators_root = t.BeaconStatePhase0.fields[
+        "validators"
+    ].hash_tree_root(state.validators)
+
+    if spec.ALTAIR_FORK_EPOCH == 0:
+        state = upgrade_to_altair(state, spec)
+        state.fork.previous_version = spec.ALTAIR_FORK_VERSION
+        state.latest_block_header.body_root = (
+            t.BeaconBlockBodyAltair().hash_tree_root()
+        )
+        if spec.BELLATRIX_FORK_EPOCH == 0:
+            state = upgrade_to_bellatrix(state, spec)
+            state.fork.previous_version = spec.BELLATRIX_FORK_VERSION
+            state.latest_block_header.body_root = (
+                t.BeaconBlockBodyBellatrix().hash_tree_root()
+            )
+    return state

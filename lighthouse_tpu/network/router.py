@@ -110,6 +110,8 @@ class Router:
                     )
                     continue
                 self.stats["attestations_rejected"] += 1
+                if str(res) == "pubkey cache lock timeout":
+                    continue  # node-local contention, not the peer's fault
                 if ev.peer_id is not None:
                     self.peer_manager.report_peer(
                         ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
@@ -123,13 +125,15 @@ class Router:
                 self.publish(kind, ev.payload, forward=True)
 
     def _work_aggregate_batch(self, events: list[WorkEvent]) -> None:
-        for ev in events:
-            try:
-                verified = self.chain.verify_aggregated_attestation_for_gossip(
-                    ev.payload
-                )
-            except (AttestationError, ValueError) as e:
-                if str(e) in _UNKNOWN_BLOCK_ERRORS:
+        """gossip_methods.rs process_gossip_aggregate_batch: one device
+        batch for every aggregate's three signature sets (chain
+        batch_verify_aggregated_attestations_for_gossip)."""
+        results = self.chain.batch_verify_aggregated_attestations_for_gossip(
+            [e.payload for e in events]
+        )
+        for ev, res in zip(events, results):
+            if isinstance(res, Exception):
+                if str(res) in _UNKNOWN_BLOCK_ERRORS:
                     if ev.reprocessed:
                         self.stats["attestations_rejected"] += 1
                         continue
@@ -142,14 +146,16 @@ class Router:
                     )
                     continue
                 self.stats["attestations_rejected"] += 1
+                if str(res) == "pubkey cache lock timeout":
+                    continue  # node-local contention, not the peer's fault
                 if ev.peer_id is not None:
                     self.peer_manager.report_peer(
                         ev.peer_id, PeerAction.LOW_TOLERANCE_ERROR
                     )
                 continue
             self.stats["aggregates_verified"] += 1
-            self.chain.apply_attestation_to_fork_choice(verified)
-            self.chain.add_to_operation_pool(verified)
+            self.chain.apply_attestation_to_fork_choice(res)
+            self.chain.add_to_operation_pool(res)
             if self.publish is not None:
                 self.publish(g.BEACON_AGGREGATE_AND_PROOF, ev.payload, forward=True)
 
