@@ -137,10 +137,10 @@ class ObservedAggregates:
     # checks (an invalid copy must not censor the valid aggregate), so
     # pre-checks may only LOOK, never record.
     def is_known_root(self, epoch: int, att_root: bytes) -> bool:
-        return att_root in self._roots[epoch]
+        return att_root in self._roots.get(epoch, ())
 
     def is_known_aggregator(self, epoch: int, aggregator_index: int) -> bool:
-        return aggregator_index in self._aggregators[epoch]
+        return aggregator_index in self._aggregators.get(epoch, ())
 
     def prune(self, finalized_epoch: int) -> None:
         for m in (self._roots, self._aggregators):

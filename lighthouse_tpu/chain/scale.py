@@ -151,7 +151,9 @@ class ScaleChain:
             spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, state.fork,
             state.genesis_validators_root,
         )
-        slot_root = compute_signing_root_of_root(
+        from ..consensus.signature_sets import signing_root_of_root
+
+        slot_root = signing_root_of_root(
             uint64.hash_tree_root(slot), sel_domain
         )
         h_slot = hash_to_g2(slot_root)
@@ -171,20 +173,30 @@ class ScaleChain:
             )
 
             # aggregator search: first member whose selection proof
-            # passes is_aggregator (the VC duty check)
+            # passes is_aggregator (the VC duty check). Chunked over the
+            # WHOLE committee: at mainnet-1M committee sizes (~488) the
+            # modulo is ~30, so a fixed 64-candidate cap fails some
+            # committee almost every slot.
             agg_index = None
             proof = None
-            cand = [int(i) for i in committee[:64]]
-            proofs = bulk_g2_mul(
-                h_slot, [(i + 1) % CURVE_ORDER for i in cand]
-            )
-            for vi, pt in zip(cand, proofs):
-                pb = g2_to_compressed(pt)
-                if h.is_aggregator(len(committee), pb, spec):
-                    agg_index, proof = vi, pb
+            members = [int(i) for i in committee]
+            for lo in range(0, len(members), 64):
+                cand = members[lo:lo + 64]
+                proofs = bulk_g2_mul(
+                    h_slot, [(i + 1) % CURVE_ORDER for i in cand]
+                )
+                for vi, pt in zip(cand, proofs):
+                    pb = g2_to_compressed(pt)
+                    if h.is_aggregator(len(committee), pb, spec):
+                        agg_index, proof = vi, pb
+                        break
+                if agg_index is not None:
                     break
-            if agg_index is None:  # vanishingly unlikely at >=64 cands
-                raise RuntimeError("no aggregator in first 64 members")
+            if agg_index is None:
+                # P ~ (1-1/modulo)^len: ~3e-8 at len 488; committees
+                # without an elected aggregator simply have no aggregate
+                # that slot (the spec allows this) — skip it.
+                continue
 
             msg = t.AggregateAndProof(
                 aggregator_index=agg_index, aggregate=full,
@@ -225,8 +237,3 @@ class _NullPeerManager:
     def is_connected(self, peer_id):
         return False
 
-
-def compute_signing_root_of_root(obj_root: bytes, domain: bytes) -> bytes:
-    from ..consensus.signature_sets import signing_root_of_root
-
-    return signing_root_of_root(obj_root, domain)

@@ -149,6 +149,17 @@ def initialize_beacon_state_from_eth1(
 
     # Scheduled-at-genesis fork upgrades (reference: genesis.rs does exactly
     # this so post-altair networks can start directly at the later fork).
+    state = _apply_genesis_fork_upgrades(
+        state, spec, t, execution_payload_header
+    )
+    return state
+
+
+def _apply_genesis_fork_upgrades(state, spec, t,
+                                 execution_payload_header=None):
+    """Scheduled-at-genesis fork upgrades, shared by the deposit-replay
+    and registry-scale genesis paths (a fork added at epoch 0 must be
+    wired exactly once)."""
     if spec.ALTAIR_FORK_EPOCH == 0:
         state = upgrade_to_altair(state, spec)
         state.fork.previous_version = spec.ALTAIR_FORK_VERSION
@@ -273,16 +284,4 @@ def scale_genesis_state(compressed_pubkeys, genesis_time: int,
         "validators"
     ].hash_tree_root(state.validators)
 
-    if spec.ALTAIR_FORK_EPOCH == 0:
-        state = upgrade_to_altair(state, spec)
-        state.fork.previous_version = spec.ALTAIR_FORK_VERSION
-        state.latest_block_header.body_root = (
-            t.BeaconBlockBodyAltair().hash_tree_root()
-        )
-        if spec.BELLATRIX_FORK_EPOCH == 0:
-            state = upgrade_to_bellatrix(state, spec)
-            state.fork.previous_version = spec.BELLATRIX_FORK_VERSION
-            state.latest_block_header.body_root = (
-                t.BeaconBlockBodyBellatrix().hash_tree_root()
-            )
-    return state
+    return _apply_genesis_fork_upgrades(state, spec, t)

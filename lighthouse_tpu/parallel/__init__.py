@@ -2,6 +2,7 @@
 
 from .sharding import (
     build_sharded_fused_indexed_verifier,
+    build_sharded_fused_smoke,
     build_sharded_fused_verifier,
     build_sharded_verifier,
     make_mesh,
@@ -9,6 +10,7 @@ from .sharding import (
 
 __all__ = [
     "build_sharded_fused_indexed_verifier",
+    "build_sharded_fused_smoke",
     "build_sharded_fused_verifier",
     "build_sharded_verifier",
     "make_mesh",

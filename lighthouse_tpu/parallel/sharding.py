@@ -221,6 +221,79 @@ def build_sharded_fused_verifier(mesh: Mesh, with_msm: bool = False):
     return body
 
 
+def build_sharded_fused_smoke(mesh: Mesh):
+    """Driver-budget certification of the fused-pipeline COMPOSITION:
+    a real production Pallas kernel (the G1 scalar-mul ladder) executing
+    inside shard_map, its per-chip outputs combined with the SAME
+    collective pattern ``_verify_core_fused(axis=...)`` uses — psum'd
+    validity, all_gather + log-fold of per-chip partial points, and
+    axis_index masking.
+
+    Why a smoke and not the full fused pipeline: in interpret mode every
+    kernel body inlines into the outer jaxpr, and the full pipeline's
+    TRACE alone measures ~17 min on the 1-core gate host — unfittable in
+    any driver budget and uncacheable (compile caches skip backend
+    compilation, not tracing; on TPU this cost does not exist because
+    Mosaic kernels stay opaque). The full fused pipeline at multichip
+    runs in the test suite (tests/test_parallel.py) and single-chip on
+    hardware in bench.py; set DRYRUN_FULL_FUSED=1 to run it in the gate
+    anyway.
+
+    Checks a real cross-chip identity: chip i kernel-computes [1]G, the
+    chips fold their partials to [n]G, and every chip kernel-computes
+    [n]G directly — fold == direct must hold, with only rank 0's lane
+    contributing the check pair (the fused verifier's replicated-pair
+    masking)."""
+    from ..ops.tkernel_calls import scalar_mul_g1_t
+
+    n = mesh.shape["dp"] * mesh.shape.get("mp", 1)
+    flat = Mesh(mesh.devices.reshape(-1), ("dp",))
+
+    @partial(
+        shard_map, mesh=flat, in_specs=(P("dp"),), out_specs=P(),
+        check_rep=False,
+    )
+    def body(one_bits):  # [1, 64] per chip: the scalar 1, MSB-first
+        T = one_bits.shape[0]
+        gx = jnp.broadcast_to(G1_GEN_DEV[0][:, None], (48, T))
+        gy = jnp.broadcast_to(G1_GEN_DEV[1][:, None], (48, T))
+        inf = jnp.zeros((1, T), jnp.int32)
+
+        # chip-local kernel run: [1]G per lane
+        X, Y, Z = scalar_mul_g1_t(gx, gy, inf, one_bits.T)
+        part = tuple(
+            jnp.moveaxis(c, -1, 0) for c in (X, Y, Z)
+        )  # [T, 48] classic layout
+
+        # collective: gather per-chip partials, log-fold (the fused
+        # verifier's RLC-accumulator pattern)
+        parts = tuple(jax.lax.all_gather(c, "dp") for c in part)
+        total = _fold_points(FP_OPS, parts, n)            # [n]G (Jacobian)
+
+        # direct check: every chip kernel-computes [n]G; only rank 0's
+        # comparison contributes (replicated-pair masking)
+        n_bits = jnp.broadcast_to(
+            jnp.asarray(
+                [[(n >> (63 - b)) & 1 for b in range(64)]], jnp.int32
+            ),
+            (T, 64),
+        )
+        Xn, Yn, Zn = scalar_mul_g1_t(gx, gy, inf, n_bits.T)
+        direct = tuple(jnp.moveaxis(c, -1, 0) for c in (Xn, Yn, Zn))
+
+        ta = pt_to_affine(FP_OPS, total)
+        da = pt_to_affine(FP_OPS, direct)
+        eq = (
+            jnp.all(ta[0] == da[0]) & jnp.all(ta[1] == da[1])
+            & jnp.all(ta[2] == da[2])
+        )
+        on_rank0 = jax.lax.axis_index("dp") == 0
+        bad = jnp.where(on_rank0 & ~eq, 1, 0)
+        return (jax.lax.psum(bad, "dp") == 0)[None]
+
+    return body
+
+
 def build_sharded_fused_indexed_verifier(mesh: Mesh, with_msm: bool = False):
     """Sharded fused verifier fed from the HBM pubkey table.
 
