@@ -145,6 +145,19 @@ def build_parser() -> argparse.ArgumentParser:
     eg.add_argument("--validator-count", type=int, default=16)
     eg.add_argument("--eth1-block-hash", default="0x" + "42" * 32)
     eg.add_argument("--eth1-timestamp", type=int, default=1_606_824_000)
+    dd = lsub.add_parser("deploy-deposit-contract",
+                         help="deploy the deposit contract over eth1 "
+                              "JSON-RPC and optionally submit "
+                              "deterministic validator deposits")
+    dd.add_argument("--eth1-http", required=True,
+                    help="eth1 JSON-RPC endpoint")
+    dd.add_argument("--confirmations", type=int, default=1)
+    dd.add_argument("--validator-count", type=int, default=None,
+                    help="submit deposits for this many insecure "
+                         "(interop-key) validators after deploying")
+    dd.add_argument("--bytecode-file", default=None,
+                    help="compiled contract creation bytecode (hex); "
+                         "default is the mock-EL marker payload")
     sk = lsub.add_parser("skip-slots")
     sk.add_argument("--slots", type=int, required=True)
     sk.add_argument("--validator-count", type=int, default=16)
@@ -522,6 +535,41 @@ def run_lcli(args) -> int:
             + bytes(state.genesis_validators_root).hex(),
             "validators": len(state.validators),
         }))
+        return 0
+    if args.action == "deploy-deposit-contract":
+        # lcli deploy_deposit_contract (reference: lcli/src/
+        # deploy_deposit_contract.rs): deploy over eth1 JSON-RPC, wait
+        # confirmations, print the address, then optionally submit
+        # deterministic insecure-validator deposits.
+        from .execution.deposit_contract import (
+            MOCK_DEPOSIT_RUNTIME,
+            DepositContractClient,
+            DepositContractError,
+        )
+
+        client = DepositContractClient(args.eth1_http)
+        try:
+            bytecode = MOCK_DEPOSIT_RUNTIME
+            if args.bytecode_file:
+                try:
+                    with open(args.bytecode_file) as f:
+                        bytecode = bytes.fromhex(
+                            f.read().strip().removeprefix("0x")
+                        )
+                except (OSError, ValueError) as e:
+                    raise DepositContractError(
+                        f"bytecode file {args.bytecode_file}: {e}"
+                    ) from e
+            address = client.deploy(bytecode, args.confirmations)
+            print(f"Deposit contract address: {address}")
+            if args.validator_count:
+                amount = spec.preset.MAX_EFFECTIVE_BALANCE
+                for i in range(args.validator_count):
+                    print(f"Submitting deposit for validator {i}...")
+                    client.deposit_deterministic(address, i, amount, spec)
+        except DepositContractError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         return 0
     if args.action == "eth1-genesis":
         # lcli eth1_genesis: the deposit-contract path — REAL signed

@@ -1,0 +1,161 @@
+"""Deposit-contract deployment + deposit submission over eth1 JSON-RPC.
+
+The reference's ``lcli deploy-deposit-contract`` (reference:
+lcli/src/deploy_deposit_contract.rs + testing/eth1_test_rig/src/lib.rs)
+deploys the compiled deposit contract through web3, prints its address,
+and optionally submits deterministic insecure-validator deposits. This
+module runs the same workflow over raw JSON-RPC (urllib — no web3
+dependency): a contract-creation ``eth_sendTransaction``, a
+confirmation wait on ``eth_getTransactionReceipt`` depth, then
+``deposit()`` calls whose DepositData roots are computed with the
+consensus SSZ containers, so the logs the eth1 follower
+(execution/eth1.py) collects verify against the incremental deposit
+tree.
+
+Call encoding: the canonical contract takes ``deposit(bytes pubkey,
+bytes withdrawal_credentials, bytes signature, bytes32
+deposit_data_root)`` with the amount as msg.value (gwei). Because every
+argument is fixed-size in practice (48/32/96/32), the wire form used
+here is the flat concatenation ``selector || pubkey || wc || sig ||
+root`` — the layout MockExecutionServer decodes. Against a real EL,
+pass ``--bytecode-file`` with the canonical compiled bytecode; this
+image vendors none (and runs no EVM), so the default creation payload
+is a one-byte marker the mock recognises.
+"""
+
+from __future__ import annotations
+
+import time
+from hashlib import sha256
+
+from .engine_api import EngineApiClient, EngineApiError
+
+# 4-byte selector for deposit(bytes,bytes,bytes,bytes32). The canonical
+# selector is keccak-derived; without a keccak implementation in-image
+# the mock protocol pins sha256("deposit(bytes,bytes,bytes,bytes32)")[:4]
+# — stated here so both sides agree (real-EL users interact through
+# their own tooling, not this constant).
+DEPOSIT_SELECTOR = sha256(b"deposit(bytes,bytes,bytes,bytes32)").digest()[:4]
+
+# Default creation payload when no --bytecode-file is given: a marker the
+# mock EL maps to "instantiate the deposit-contract handler here".
+MOCK_DEPOSIT_RUNTIME = b"\xde"
+
+
+class DepositContractError(Exception):
+    pass
+
+
+class DepositContractClient:
+    """Raw-JSON-RPC deployer/depositor (eth1_test_rig's DepositContract)."""
+
+    def __init__(self, url: str, sender: str | None = None,
+                 timeout: float = 8.0):
+        self.url = url
+        # eth1 JSON-RPC is unauthenticated; EngineApiClient is the one
+        # JSON-RPC transport in this package (same error surfacing).
+        self._client = EngineApiClient(url, jwt=None, timeout=timeout)
+        # Dev-chain coordinator account (the mock accepts any sender;
+        # a real dev EL would use its unlocked account).
+        self.sender = sender or "0x" + "ec" * 20
+
+    # ------------------------------------------------------------- plumbing
+    def _rpc(self, method: str, params: list):
+        try:
+            return self._client._call(method, params)
+        except EngineApiError as e:
+            raise DepositContractError(f"eth1 RPC {method}: {e}") from e
+
+    def block_number(self) -> int:
+        return int(self._rpc("eth_blockNumber", []), 16)
+
+    def _wait_receipt(self, tx_hash: str, timeout: float = 30.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rcpt = self._rpc("eth_getTransactionReceipt", [tx_hash])
+            if rcpt is not None:
+                return rcpt
+            time.sleep(0.05)
+        raise DepositContractError(f"no receipt for {tx_hash} in {timeout}s")
+
+    def _wait_confirmations(self, block_number: int, confirmations: int,
+                            timeout: float = 60.0) -> None:
+        """Depth wait: confirmed once head >= block + confirmations - 1
+        (the tx's own block counts as confirmation one)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.block_number() >= block_number + confirmations - 1:
+                return
+            time.sleep(0.1)
+        raise DepositContractError(
+            f"block {block_number} not {confirmations}-confirmed in {timeout}s"
+        )
+
+    # ------------------------------------------------------------- workflow
+    def deploy(self, bytecode: bytes = MOCK_DEPOSIT_RUNTIME,
+               confirmations: int = 1, timeout: float = 60.0) -> str:
+        """Deploy the contract; returns its 0x address."""
+        tx_hash = self._rpc("eth_sendTransaction", [{
+            "from": self.sender,
+            "data": "0x" + bytecode.hex(),
+        }])
+        rcpt = self._wait_receipt(tx_hash, timeout)
+        if rcpt.get("status") != "0x1":
+            raise DepositContractError("creation transaction reverted")
+        addr = rcpt.get("contractAddress")
+        if not addr:
+            raise DepositContractError("creation receipt has no address")
+        self._wait_confirmations(int(rcpt["blockNumber"], 16),
+                                 max(1, confirmations), timeout)
+        return addr
+
+    def deposit(self, address: str, pubkey: bytes,
+                withdrawal_credentials: bytes, signature: bytes,
+                amount_gwei: int, data_root: bytes,
+                timeout: float = 30.0) -> dict:
+        """Submit one deposit() transaction; returns the receipt."""
+        if len(pubkey) != 48 or len(withdrawal_credentials) != 32:
+            raise DepositContractError("bad pubkey/withdrawal lengths")
+        if len(signature) != 96 or len(data_root) != 32:
+            raise DepositContractError("bad signature/root lengths")
+        calldata = (DEPOSIT_SELECTOR + pubkey + withdrawal_credentials
+                    + signature + data_root)
+        tx_hash = self._rpc("eth_sendTransaction", [{
+            "from": self.sender,
+            "to": address,
+            "value": hex(amount_gwei),
+            "data": "0x" + calldata.hex(),
+        }])
+        rcpt = self._wait_receipt(tx_hash, timeout)
+        if rcpt.get("status") != "0x1":
+            raise DepositContractError(
+                f"deposit transaction reverted ({tx_hash})"
+            )
+        return rcpt
+
+    def deposit_deterministic(self, address: str, index: int,
+                              amount_gwei: int, spec) -> dict:
+        """Deposit for insecure validator ``index`` (reference:
+        eth1_test_rig deposit_deterministic_async: interop key, BLS
+        withdrawal credentials, signed DepositData)."""
+        from ..consensus.genesis import (
+            bls_withdrawal_credentials,
+            interop_secret_key,
+        )
+        from ..consensus.config import compute_signing_root
+        from ..consensus.types import DepositData, DepositMessage
+
+        sk = interop_secret_key(index)
+        pubkey = sk.public_key().to_bytes()
+        wc = bls_withdrawal_credentials(pubkey)
+        message = DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=wc, amount=amount_gwei,
+        )
+        domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+        signature = sk.sign(compute_signing_root(message, domain)).to_bytes()
+        data = DepositData(
+            pubkey=pubkey, withdrawal_credentials=wc, amount=amount_gwei,
+            signature=signature,
+        )
+        return self.deposit(address, pubkey, wc, signature, amount_gwei,
+                            data.hash_tree_root())

@@ -179,10 +179,17 @@ class MockExecutionServer:
     """Engine-API + eth1 JSON-RPC over real HTTP (test_utils/mock_server)."""
 
     def __init__(self, generator: ExecutionBlockGenerator | None = None,
-                 jwt_secret: bytes | None = None, port: int = 0):
+                 jwt_secret: bytes | None = None, port: int = 0,
+                 mine_interval: float | None = None):
         self.generator = generator or ExecutionBlockGenerator()
         self.jwt = JwtAuth(jwt_secret) if jwt_secret is not None else None
         self.deposit_logs: list[dict] = []  # eth1 deposit events
+        # Minimal transaction surface for the deposit-contract workflow
+        # (reference: testing/eth1_test_rig): creation txs instantiate a
+        # contract account, calls to a deposit contract append logs.
+        self.contracts: dict[str, bytes] = {}  # address -> code
+        self.receipts: dict[str, dict] = {}  # tx hash -> receipt
+        self._nonces: dict[str, int] = {}  # sender -> next nonce
         gen = self.generator
         server_ref = self
 
@@ -200,10 +207,14 @@ class MockExecutionServer:
                         return
                 length = int(self.headers.get("Content-Length") or 0)
                 req = json.loads(self.rfile.read(length))
-                result = server_ref._dispatch(req["method"], req.get("params", []))
-                body = json.dumps(
-                    {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
-                ).encode()
+                reply = {"jsonrpc": "2.0", "id": req.get("id")}
+                try:
+                    reply["result"] = server_ref._dispatch(
+                        req["method"], req.get("params", [])
+                    )
+                except Exception as e:  # JSON-RPC error, not a dropped conn
+                    reply["error"] = {"code": -32000, "message": str(e)}
+                body = json.dumps(reply).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -214,8 +225,25 @@ class MockExecutionServer:
         self.port = self._httpd.server_address[1]
         self.url = f"http://127.0.0.1:{self.port}"
         self._thread: threading.Thread | None = None
+        # Dev-chain auto-miner: without it the chain only advances on
+        # transactions, so a confirmation-depth wait (deposit_contract
+        # _wait_confirmations with confirmations > 1) can never be
+        # satisfied. Enable for tests that need head progress.
+        self._mine_interval = mine_interval
+        self._mine_stop = threading.Event()
+        self._miner: threading.Thread | None = None
+        # Guards generator mutation: the miner thread and the (threaded)
+        # request handlers both insert blocks.
+        self._chain_lock = threading.Lock()
 
     def _dispatch(self, method: str, params: list):
+        # Coarse lock: handler threads and the auto-miner all touch the
+        # generator's dicts (reads iterate them — a concurrent
+        # insert_pow_block is a 'dict changed size' RuntimeError).
+        with self._chain_lock:
+            return self._dispatch_locked(method, params)
+
+    def _dispatch_locked(self, method: str, params: list):
         gen = self.generator
         if method == "engine_newPayloadV1":
             return gen.new_payload(params[0])
@@ -231,6 +259,13 @@ class MockExecutionServer:
             tag = params[0]
             number = gen.head_number if tag == "latest" else int(tag, 16)
             return gen.block_by_number_json(number)
+        if method == "eth_sendTransaction":
+            return self._send_transaction(params[0])
+        if method == "eth_getTransactionReceipt":
+            return self.receipts.get(params[0])
+        if method == "eth_getCode":
+            code = self.contracts.get(params[0].lower())
+            return "0x" + code.hex() if code is not None else "0x"
         if method == "eth_getLogs":
             filt = params[0]
             lo = int(filt.get("fromBlock", "0x0"), 16)
@@ -241,13 +276,85 @@ class MockExecutionServer:
             ]
         raise ValueError(f"unknown method {method}")
 
+    def _send_transaction(self, tx: dict) -> str:
+        """Mock tx processing: every tx mines one PoW block. Creation txs
+        instantiate a contract account (address = sha256(sender||nonce)
+        [:20] — mock derivation; no keccak/RLP in-image and nothing
+        depends on mainnet address math). Calls to a known contract with
+        the deposit selector append a DepositEvent-shaped log the eth1
+        follower consumes (execution/eth1.py insert_log). Runs under
+        _dispatch's _chain_lock (atomic vs other handlers + the miner)."""
+        from hashlib import sha256
+
+        sender = (tx.get("from") or "0x" + "00" * 20).lower()
+        nonce = self._nonces.get(sender, 0)
+        self._nonces[sender] = nonce + 1
+        data = bytes.fromhex(tx.get("data", "0x").removeprefix("0x"))
+        block = self.generator.insert_pow_block()
+        tx_hash = "0x" + sha256(
+            json.dumps(tx, sort_keys=True).encode() + nonce.to_bytes(8, "big")
+        ).hexdigest()
+        receipt = {
+            "transactionHash": tx_hash,
+            "blockNumber": hex(block.number),
+            "blockHash": "0x" + block.block_hash.hex(),
+            "status": "0x1",
+            "contractAddress": None,
+        }
+        to = tx.get("to")
+        if to is None:
+            addr = "0x" + sha256(
+                bytes.fromhex(sender.removeprefix("0x"))
+                + nonce.to_bytes(8, "big")
+            ).digest()[:20].hex()
+            self.contracts[addr] = data
+            receipt["contractAddress"] = addr
+        else:
+            from .deposit_contract import DEPOSIT_SELECTOR
+
+            to = to.lower()
+            if to not in self.contracts:
+                receipt["status"] = "0x0"  # call to a non-contract
+            elif data[:4] == DEPOSIT_SELECTOR and len(data) == 4 + 48 + 32 + 96 + 32:
+                pubkey = data[4:52]
+                wc = data[52:84]
+                sig = data[84:180]
+                root = data[180:212]
+                index = len(self.deposit_logs)
+                self.deposit_logs.append({
+                    "index": str(index),
+                    "blockNumber": hex(block.number),
+                    "data_root": "0x" + root.hex(),
+                    "pubkey": "0x" + pubkey.hex(),
+                    "withdrawal_credentials": "0x" + wc.hex(),
+                    "amount": str(int(tx.get("value", "0x0"), 16)),
+                    "signature": "0x" + sig.hex(),
+                    "address": to,
+                })
+            else:
+                receipt["status"] = "0x0"  # malformed calldata
+        self.receipts[tx_hash] = receipt
+        return tx_hash
+
     def start(self) -> "MockExecutionServer":
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self._mine_interval is not None:
+
+            def _mine():
+                while not self._mine_stop.wait(self._mine_interval):
+                    with self._chain_lock:
+                        self.generator.insert_pow_block()
+
+            self._miner = threading.Thread(target=_mine, daemon=True)
+            self._miner.start()
         return self
 
     def stop(self) -> None:
+        self._mine_stop.set()
+        if self._miner is not None:
+            self._miner.join(timeout=2)
         self._httpd.shutdown()
         self._httpd.server_close()

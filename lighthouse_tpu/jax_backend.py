@@ -66,6 +66,38 @@ from .ops.tower import fp12_is_one, fp12_mul
 from .utils import next_pow2 as _next_pow2
 
 
+def _fused_choice() -> str:
+    """"1" -> fused Pallas kernels, "0" -> classic XLA. Fused is the TPU
+    production path (3-5x the classic program); off-TPU Mosaic isn't
+    available and interpret-mode compile cost dominates, so classic
+    stays the default there. LHTPU_FUSED_VERIFY=0/1 overrides. One
+    policy shared by batch verify (_dispatch) and AggregateVerify."""
+    import os
+
+    choice = os.environ.get("LHTPU_FUSED_VERIFY")
+    if choice is None:
+        choice = "1" if jax.default_backend() == "tpu" else "0"
+    return choice
+
+
+def _pad_pair_lanes(g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf, pad: int):
+    """Pad multi-pairing operands with ``pad`` inert lanes (replicate the
+    last row's coordinates, mark the lane infinity -> contributes Fp12
+    one). Shared by the classic batch and aggregate cores."""
+    if pad:
+        g1_x = jnp.concatenate([g1_x, jnp.broadcast_to(g1_x[-1:], (pad, 48))])
+        g1_y = jnp.concatenate([g1_y, jnp.broadcast_to(g1_y[-1:], (pad, 48))])
+        g1_inf = jnp.concatenate([g1_inf, jnp.ones((pad,), bool)])
+        g2_x = jnp.concatenate(
+            [g2_x, jnp.broadcast_to(g2_x[-1:], (pad, 2, 48))]
+        )
+        g2_y = jnp.concatenate(
+            [g2_y, jnp.broadcast_to(g2_y[-1:], (pad, 2, 48))]
+        )
+        g2_inf = jnp.concatenate([g2_inf, jnp.ones((pad,), bool)])
+    return g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf
+
+
 def _verify_core(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
     """The jitted device program. All shapes static.
 
@@ -110,14 +142,9 @@ def _verify_core(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
     g2_inf = jnp.concatenate([msg_inf, sig_acc_aff[2]])
 
     M = _next_pow2(S + 1)
-    pad = M - (S + 1)
-    if pad:
-        g1_x = jnp.concatenate([g1_x, jnp.broadcast_to(g1_x[-1:], (pad, 48))])
-        g1_y = jnp.concatenate([g1_y, jnp.broadcast_to(g1_y[-1:], (pad, 48))])
-        g1_inf = jnp.concatenate([g1_inf, jnp.ones((pad,), bool)])
-        g2_x = jnp.concatenate([g2_x, jnp.broadcast_to(g2_x[-1:], (pad, 2, 48))])
-        g2_y = jnp.concatenate([g2_y, jnp.broadcast_to(g2_y[-1:], (pad, 2, 48))])
-        g2_inf = jnp.concatenate([g2_inf, jnp.ones((pad,), bool)])
+    g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf = _pad_pair_lanes(
+        g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf, M - (S + 1)
+    )
 
     f = miller_loop((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf)
     f = fp12_tree_prod(f, M)
@@ -126,6 +153,46 @@ def _verify_core(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
 
 
 _verify_jit = jax.jit(_verify_core)
+
+
+# --- mesh collective building blocks of the fused path -------------------
+# Named and separated so the fast test tier can certify the collective
+# composition on the CPU mesh WITHOUT the Pallas kernel bodies (whose
+# interpret-mode trace costs ~17 min): tests/test_parallel.py
+# test_fused_collectives_match_host runs exactly these functions inside
+# shard_map against a host oracle. _verify_core_fused(axis=...) calls
+# them verbatim, so a broken all_gather/fold/psum/axis_index composition
+# fails the fast tier, not just TPU hardware.
+
+
+def mesh_all_ok(ok_lanes, axis):
+    """Global AND of per-chip boolean lanes (psum of failure counts)."""
+    bad = jax.lax.psum(jnp.sum(~ok_lanes), axis)
+    return bad == 0
+
+
+def mesh_fold_point(ops, point, axis):
+    """Fold per-chip partial-sum points over the mesh axis: all_gather
+    of one point per chip, then a scan fold (group law is not a ring
+    sum — psum cannot combine it)."""
+    from .ops.points import pt_fold_scan
+
+    parts = tuple(jax.lax.all_gather(c, axis) for c in point)
+    return pt_fold_scan(ops, parts, parts[0].shape[0])
+
+
+def mesh_rank0_lane(axis):
+    """Infinity mask keeping only rank 0's check-pair lane finite (the
+    folded accumulator is replicated; other ranks contribute Fp12 one)."""
+    return (jax.lax.axis_index(axis) != 0)[None]
+
+
+def mesh_fold_fp12(f1, axis):
+    """Fold per-chip Fp12 Miller partials over the mesh axis."""
+    from .ops.pairing import fp12_fold_scan
+
+    f_all = jax.lax.all_gather(f1, axis)
+    return fp12_fold_scan(f_all, f_all.shape[0])
 
 
 def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
@@ -153,8 +220,7 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
     """
     from .ops import tkernel as tk
     from .ops import tkernel_calls as tc
-    from .ops.pairing import fp12_fold_scan, fp12_tree_prod
-    from .ops.points import pt_fold_scan
+    from .ops.pairing import fp12_tree_prod
 
     S, K = pk_inf.shape
 
@@ -190,8 +256,7 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
     if axis is None:
         sub_ok = jnp.all(ok_lanes)
     else:
-        bad = jax.lax.psum(jnp.sum(~ok_lanes), axis)
-        sub_ok = bad == 0
+        sub_ok = mesh_all_ok(ok_lanes, axis)
 
     # sum_i [r_i] sig_i: bucketed MSM (one kernel pair) or the scan
     # path's log2 S tree; + mesh fold; then one affine kernel.
@@ -203,8 +268,7 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
         rsig_c = tuple(tk.batch_from_t(c) for c in rsig)
         sig_acc = pt_tree_sum(FP2_OPS, rsig_c, S)
     if axis is not None:
-        parts = tuple(jax.lax.all_gather(c, axis) for c in sig_acc)
-        sig_acc = pt_fold_scan(FP2_OPS, parts, parts[0].shape[0])
+        sig_acc = mesh_fold_point(FP2_OPS, sig_acc, axis)
     sig_acc_t = tuple(tk.batch_to_t(c[None]) for c in sig_acc)
     sax, say, sainf = tc.to_affine_g2_t(sig_acc_t)
 
@@ -221,9 +285,7 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
     # The check pair is replicated across a mesh (sig_acc is folded), so
     # only rank 0 keeps its lane finite — the others contribute Fp12 one.
     chk_inf = (
-        jnp.zeros((1,), bool)
-        if axis is None
-        else (jax.lax.axis_index(axis) != 0)[None]
+        jnp.zeros((1,), bool) if axis is None else mesh_rank0_lane(axis)
     )
     g1_inf = jnp.concatenate([rinf, chk_inf])
     msg_t = (tk.batch_to_t(msg[0]), tk.batch_to_t(msg[1]))
@@ -242,8 +304,7 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
         f_c = jnp.concatenate([f_c, ones])
     f1 = fp12_tree_prod(f_c, M)
     if axis is not None:
-        f_all = jax.lax.all_gather(f1, axis)
-        f1 = fp12_fold_scan(f_all, f_all.shape[0])
+        f1 = mesh_fold_fp12(f1, axis)
 
     # Final exponentiation (≈1000-step chain -> kernel, single lane;
     # replicated per chip under a mesh — one tiny lane, not worth a
@@ -304,6 +365,42 @@ def _aggregate_verify_core_fused(pkx, pky, pkinf, mx, my, minf,
 _aggregate_verify_fused_jit = jax.jit(_aggregate_verify_core_fused)
 
 
+def _aggregate_verify_core(pkx, pky, pkinf, mx, my, minf,
+                           sigx, sigy, siginf):
+    """Classic-XLA AggregateVerify core — the off-TPU twin of
+    _aggregate_verify_core_fused (same multi-pairing + ψ subgroup
+    check, classic ops). The fused core's Pallas bodies inline into
+    the outer jaxpr under CPU interpret mode and the resulting
+    XLA:CPU compile explodes (observed: 100 GB compiler RSS, killed)
+    — the same hazard that keeps _dispatch on the classic path
+    off-TPU."""
+    N = pkinf.shape[0]
+
+    sig_j = pt_from_affine(FP2_OPS, sigx, sigy, siginf)
+    sub_ok = jnp.all(pt_subgroup_check(FP2_OPS, sig_j))
+
+    neg_g1 = (G1_GEN_DEV[0][None], limb.neg(G1_GEN_DEV[1])[None])
+    g1_x = jnp.concatenate([pkx, neg_g1[0]])
+    g1_y = jnp.concatenate([pky, neg_g1[1]])
+    g1_inf = jnp.concatenate([pkinf, jnp.zeros((1,), bool)])
+    g2_x = jnp.concatenate([mx, sigx])
+    g2_y = jnp.concatenate([my, sigy])
+    g2_inf = jnp.concatenate([minf, siginf])
+
+    M = _next_pow2(N + 1)
+    g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf = _pad_pair_lanes(
+        g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf, M - (N + 1)
+    )
+
+    f = miller_loop((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf)
+    f = fp12_tree_prod(f, M)
+    f = final_exponentiation(f)
+    return fp12_is_one(f) & sub_ok
+
+
+_aggregate_verify_jit = jax.jit(_aggregate_verify_core)
+
+
 def aggregate_verify_device(pubkeys, messages, signature) -> bool:
     """AggregateVerify on device from API objects (jax analogue of
     api.AggregateSignature.aggregate_verify; structural edge cases
@@ -332,7 +429,12 @@ def aggregate_verify_device(pubkeys, messages, signature) -> bool:
     backend = JaxBackend()
     mx, my, minf = backend._hash_message_bytes(messages, N, inf2)
     sigx, sigy, siginf = g2_to_dev([signature.point])
-    ok = _aggregate_verify_fused_jit(
+    fn = (
+        _aggregate_verify_fused_jit
+        if _fused_choice() == "1"
+        else _aggregate_verify_jit
+    )
+    ok = fn(
         jnp.asarray(pkx), jnp.asarray(pky), jnp.asarray(pkinf),
         jnp.asarray(mx), jnp.asarray(my), jnp.asarray(minf),
         jnp.asarray(sigx), jnp.asarray(sigy), jnp.asarray(siginf),
@@ -536,14 +638,8 @@ class JaxBackend:
         S = _next_pow2(n)
         K = _next_pow2(max(len(s.signing_keys) for s in sets))
 
-        # Path choice up front (it shapes the padding). Fused Pallas
-        # kernels are the production path on TPU (3-5x the classic XLA
-        # program, see ops/tkernel*.py); the classic path stays default
-        # off-TPU where Mosaic isn't available and the interpreter's
-        # compile cost dominates. LHTPU_FUSED_VERIFY=0/1 overrides.
-        choice = os.environ.get("LHTPU_FUSED_VERIFY")
-        if choice is None:
-            choice = "1" if jax.default_backend() == "tpu" else "0"
+        # Path choice up front (it shapes the padding).
+        choice = _fused_choice()
         n_dev = len(jax.devices())
         shard = os.environ.get("LHTPU_SHARDED_VERIFY")
         use_sharded = choice == "1" and (

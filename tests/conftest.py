@@ -94,7 +94,8 @@ def pytest_collection_modifyitems(session, config, items):
     ops after the fused kernels landed — never in isolation or early,
     big thread stacks notwithstanding). Early in the process both the
     cache read and a fresh compile are reliable."""
-    early = ("test_parallel", "test_tkernel", "test_pallas_mont")
+    early = ("test_parallel", "test_jax_backend", "test_tkernel",
+             "test_pallas_mont")
 
     def rank(item):
         for i, name in enumerate(early):

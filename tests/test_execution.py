@@ -309,3 +309,137 @@ class TestMergeChain:
             assert node.execution_status == ExecutionStatus.VALID
         finally:
             server.stop()
+
+
+class TestDepositContract:
+    """Deploy + deposit workflow (reference: lcli/src/
+    deploy_deposit_contract.rs + testing/eth1_test_rig): contract
+    creation over eth1 JSON-RPC, deterministic deposits, and the logs
+    landing in the eth1 follower with verifying tree proofs."""
+
+    @pytest.fixture()
+    def eth1_el(self):
+        # eth1 JSON-RPC is unauthenticated (JWT guards only the engine
+        # API port on real setups).
+        server = MockExecutionServer(ExecutionBlockGenerator()).start()
+        yield server
+        server.stop()
+
+    def test_deploy_and_deposit_roundtrip(self, eth1_el, fake_backend):
+        from lighthouse_tpu.consensus.config import minimal_spec
+        from lighthouse_tpu.consensus.genesis import interop_secret_key
+        from lighthouse_tpu.execution.deposit_contract import (
+            DepositContractClient,
+        )
+
+        spec = minimal_spec()
+        client = DepositContractClient(eth1_el.url)
+        address = client.deploy(confirmations=1)
+        assert address.startswith("0x") and len(address) == 42
+        # the contract account exists
+        assert client._rpc("eth_getCode", [address]) != "0x"
+
+        for i in range(4):
+            rcpt = client.deposit_deterministic(
+                address, i, spec.preset.MAX_EFFECTIVE_BALANCE, spec
+            )
+            assert rcpt["status"] == "0x1"
+
+        # The follower picks the logs up in order and the tree proofs
+        # verify exactly as process_deposit will check them.
+        svc = Eth1Service(EngineApiClient(eth1_el.url), spec)
+        svc.update()
+        assert svc.deposit_cache.count() == 4
+        from lighthouse_tpu.consensus.deposit_tree import (
+            DEPOSIT_CONTRACT_TREE_DEPTH,
+        )
+        from lighthouse_tpu.consensus.merkle_proof import (
+            is_valid_merkle_branch,
+        )
+        from lighthouse_tpu.consensus.types import DepositData
+
+        log = svc.deposit_cache.deposits[2]
+        # the log's data_root is the real SSZ hash_tree_root of the
+        # submitted DepositData
+        data = DepositData(
+            pubkey=bytes.fromhex(log["pubkey"].removeprefix("0x")),
+            withdrawal_credentials=bytes.fromhex(
+                log["withdrawal_credentials"].removeprefix("0x")
+            ),
+            amount=int(log["amount"]),
+            signature=bytes.fromhex(log["signature"].removeprefix("0x")),
+        )
+        root = bytes.fromhex(log["data_root"].removeprefix("0x"))
+        assert data.hash_tree_root() == root
+        assert bytes.fromhex(
+            log["pubkey"].removeprefix("0x")
+        ) == interop_secret_key(2).public_key().to_bytes()
+        assert is_valid_merkle_branch(
+            root, svc.deposit_cache.proof(2),
+            DEPOSIT_CONTRACT_TREE_DEPTH + 1, 2, svc.deposit_cache.root(),
+        )
+
+    def test_malformed_deposit_rejected(self, eth1_el):
+        from lighthouse_tpu.execution.deposit_contract import (
+            DepositContractClient,
+        )
+
+        client = DepositContractClient(eth1_el.url)
+        address = client.deploy(confirmations=1)
+        tx = client._rpc("eth_sendTransaction", [{
+            "from": client.sender, "to": address,
+            "value": "0x1", "data": "0x" + "ab" * 10,
+        }])
+        rcpt = client._wait_receipt(tx)
+        assert rcpt["status"] == "0x0"
+        assert eth1_el.deposit_logs == []
+        # deposit() surfaces the revert instead of returning the receipt
+        from lighthouse_tpu.execution.deposit_contract import (
+            DepositContractError,
+        )
+
+        with pytest.raises(DepositContractError, match="reverted"):
+            client.deposit("0x" + "11" * 20, b"\x01" * 48, b"\x02" * 32,
+                           b"\x03" * 96, 32_000_000_000, b"\x04" * 32)
+
+    def test_cli_deploy_command(self, eth1_el, fake_backend, capsys):
+        from lighthouse_tpu.cli import main
+
+        rc = main([
+            "lcli", "--spec", "minimal", "deploy-deposit-contract",
+            "--eth1-http", eth1_el.url,
+            "--confirmations", "1",
+            "--validator-count", "2",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Deposit contract address: 0x" in out
+        assert len(eth1_el.deposit_logs) == 2
+
+    def test_confirmation_depth_with_miner(self):
+        """confirmations > 1 needs head progress beyond the deploy tx's
+        own block — the mock's dev-chain auto-miner provides it."""
+        from lighthouse_tpu.execution.deposit_contract import (
+            DepositContractClient,
+        )
+
+        server = MockExecutionServer(
+            ExecutionBlockGenerator(), mine_interval=0.02
+        ).start()
+        try:
+            client = DepositContractClient(server.url)
+            address = client.deploy(confirmations=3, timeout=10.0)
+            assert address.startswith("0x")
+        finally:
+            server.stop()
+
+    def test_cli_bad_bytecode_file(self, eth1_el, capsys):
+        from lighthouse_tpu.cli import main
+
+        rc = main([
+            "lcli", "deploy-deposit-contract",
+            "--eth1-http", eth1_el.url,
+            "--bytecode-file", "/nonexistent/path.hex",
+        ])
+        assert rc == 1
+        assert "bytecode file" in capsys.readouterr().err

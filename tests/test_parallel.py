@@ -111,6 +111,8 @@ def test_graft_dryrun_multichip():
     __graft_entry__.dryrun_multichip(4)
 
 
+@pytest.mark.slow  # interpret-mode fused pipeline: the TRACE alone costs
+# ~17 min cold on this host (kernel bodies inline; tracing is uncacheable)
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
 @big_stack_thread
 def test_sharded_fused_matches_oracle():
@@ -145,6 +147,7 @@ def test_sharded_fused_matches_oracle():
     assert not bool(fn(*bad)[0])
 
 
+@pytest.mark.slow  # interpret-mode fused pipeline (see above)
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
 @big_stack_thread
 def test_sharded_fused_indexed_matches_oracle():
@@ -202,6 +205,7 @@ def test_sharded_fused_indexed_matches_oracle():
     assert not bool(fn(*bad)[0])
 
 
+@pytest.mark.slow  # interpret-mode fused pipeline (see above)
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 @big_stack_thread
 def test_backend_sharded_indexed_path_engages(monkeypatch):
@@ -265,3 +269,97 @@ def test_graft_entry_shapes():
     assert callable(fn)
     (pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits) = args
     assert pk[0].shape == (2, 2, 48) and r_bits.shape == (2, 64)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@big_stack_thread
+def test_fused_collectives_match_host():
+    """FAST-tier certification of the fused path's mesh collectives
+    WITHOUT the Pallas kernel bodies (whose interpret-mode trace costs
+    ~17 min and lives in the slow tier): runs the exact helpers
+    _verify_core_fused(axis=...) composes — mesh_all_ok (psum),
+    mesh_fold_point (all_gather + group-law fold), mesh_fold_fp12
+    (all_gather + Fp12 fold), mesh_rank0_lane (axis_index masking) —
+    inside shard_map on the 8-device mesh, against the same math run
+    single-device and against host group law."""
+    from jax.sharding import PartitionSpec as P
+
+    from lighthouse_tpu.crypto.bls.curve import g2_generator
+    from lighthouse_tpu.jax_backend import (
+        mesh_all_ok,
+        mesh_fold_fp12,
+        mesh_fold_point,
+        mesh_rank0_lane,
+    )
+    from lighthouse_tpu.ops.pairing import fp12_fold_scan
+    from lighthouse_tpu.ops.points import FP2_OPS, pt_from_affine, pt_to_affine
+    from lighthouse_tpu.ops.tower import fp12_to_dev
+    from lighthouse_tpu.parallel import make_mesh
+
+    try:
+        from jax.sharding import shard_map
+    except ImportError:  # older jax layout
+        from jax.experimental.shard_map import shard_map
+
+    n = 8
+    mesh = make_mesh(n, mp=1)
+
+    # --- mesh_all_ok: one bad lane anywhere -> global False -------------
+    def all_ok_prog(lanes):
+        return mesh_all_ok(lanes, "dp")[None]
+
+    f = jax.jit(shard_map(all_ok_prog, mesh=mesh, in_specs=P("dp"),
+                          out_specs=P("dp"), check_rep=False))
+    lanes = np.ones((n, 4), bool)
+    assert bool(np.asarray(f(lanes)).all())
+    lanes[5, 2] = False
+    assert not bool(np.asarray(f(lanes)).any())
+
+    # --- mesh_fold_point: fold of per-chip [k]G2 partials == [sum k]G2,
+    # vs the HOST group law ---------------------------------------------
+    ks = list(range(1, n + 1))
+    pts = [g2_generator().mul(k) for k in ks]
+    px, py, pinf = g2_to_dev(pts)
+
+    def fold_prog(x, y, inf):
+        j = pt_from_affine(FP2_OPS, x, y, inf)
+        part = tuple(c[0] for c in j)  # this chip's single point
+        acc = mesh_fold_point(FP2_OPS, part, "dp")
+        return pt_to_affine(FP2_OPS, tuple(c[None] for c in acc))
+
+    g = jax.jit(shard_map(fold_prog, mesh=mesh,
+                          in_specs=(P("dp"), P("dp"), P("dp")),
+                          out_specs=(P(), P(), P()), check_rep=False))
+    ax, ay, ainf = g(px, py, pinf)
+    ex, ey, einf = g2_to_dev([g2_generator().mul(sum(ks))])
+    assert not bool(np.asarray(ainf)[0])
+    assert np.array_equal(np.asarray(ax)[0], ex[0])
+    assert np.array_equal(np.asarray(ay)[0], ey[0])
+
+    # --- mesh_fold_fp12: mesh fold == the same fold single-device
+    # (collective wiring under test; the field math itself is covered by
+    # test_ops_tower/test_bls_pairing) ----------------------------------
+    rng = np.random.RandomState(7)
+
+    def rand_fp12():
+        c = [(int(rng.randint(1, 2**30)), int(rng.randint(1, 2**30)))
+             for _ in range(6)]
+        return fp12_to_dev(c[:3], c[3:])
+
+    vals = np.stack([rand_fp12() for _ in range(n)])  # [n, 2, 3, 2, 48]
+
+    def fp12_prog(x):
+        folded = mesh_fold_fp12(x[0][None], "dp")[0]
+        fin = (~mesh_rank0_lane("dp")).astype(jnp.int32)
+        n_fin = jax.lax.psum(fin.sum(), "dp")
+        return folded[None], n_fin[None]
+
+    h = jax.jit(shard_map(fp12_prog, mesh=mesh, in_specs=P("dp"),
+                          out_specs=(P(), P("dp")), check_rep=False))
+    folded, n_fin = h(vals)
+    expect = jax.jit(fp12_fold_scan, static_argnums=1)(
+        jnp.asarray(vals), n
+    )
+    assert np.array_equal(np.asarray(folded)[0], np.asarray(expect))
+    # rank-0 masking: exactly one finite check-pair lane across the mesh
+    assert int(np.asarray(n_fin)[0]) == 1
