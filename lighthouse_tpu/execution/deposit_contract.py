@@ -95,6 +95,7 @@ class DepositContractClient:
     def deploy(self, bytecode: bytes = MOCK_DEPOSIT_RUNTIME,
                confirmations: int = 1, timeout: float = 60.0) -> str:
         """Deploy the contract; returns its 0x address."""
+        deadline = time.monotonic() + timeout
         tx_hash = self._rpc("eth_sendTransaction", [{
             "from": self.sender,
             "data": "0x" + bytecode.hex(),
@@ -105,8 +106,12 @@ class DepositContractClient:
         addr = rcpt.get("contractAddress")
         if not addr:
             raise DepositContractError("creation receipt has no address")
-        self._wait_confirmations(int(rcpt["blockNumber"], 16),
-                                 max(1, confirmations), timeout)
+        # One shared budget: the confirmation wait gets what the receipt
+        # wait left over (never double the stated timeout).
+        self._wait_confirmations(
+            int(rcpt["blockNumber"], 16), max(1, confirmations),
+            max(1.0, deadline - time.monotonic()),
+        )
         return addr
 
     def deposit(self, address: str, pubkey: bytes,

@@ -76,6 +76,12 @@ SLOW_MODULES = {
     "test_htc",         # hash-to-curve kernel property tests
     "test_tpu_parity",  # hardware parity sweeps (TPU-targeted)
     "test_pallas_mont",  # montgomery kernel property tests
+    # Classic-engine op-level property sweeps (~5 min of the fast tier;
+    # the classic engine stays fast-tier-covered end-to-end through
+    # test_jax_backend / test_parallel / test_blsrt verify paths).
+    "test_ops_points",
+    "test_ops_pairing",
+    "test_ops_tower",
 }
 
 

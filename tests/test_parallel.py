@@ -103,6 +103,8 @@ def test_sharded_verifier_matches_oracle():
     assert not bool(fn(*bad)[0])
 
 
+@pytest.mark.slow  # the driver runs this exact gate itself every round;
+# in-suite it is regression cover for gate EDITS, not routine CI (129 s)
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
 @big_stack_thread
 def test_graft_dryrun_multichip():
@@ -247,6 +249,7 @@ def test_backend_sharded_indexed_path_engages(monkeypatch):
         blsrt.set_device_table(None)
 
 
+@pytest.mark.slow  # the driver runs this exact gate itself every round (186 s)
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 @big_stack_thread
 def test_graft_dryrun_multichip_8():
