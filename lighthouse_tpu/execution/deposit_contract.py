@@ -100,7 +100,9 @@ class DepositContractClient:
             "from": self.sender,
             "data": "0x" + bytecode.hex(),
         }])
-        rcpt = self._wait_receipt(tx_hash, timeout)
+        rcpt = self._wait_receipt(
+            tx_hash, max(1.0, deadline - time.monotonic())
+        )
         if rcpt.get("status") != "0x1":
             raise DepositContractError("creation transaction reverted")
         addr = rcpt.get("contractAddress")

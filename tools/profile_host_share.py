@@ -43,6 +43,19 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    # PROFILE_PLATFORM=cpu forces CPU via jax.config (the image's
+    # sitecustomize overrides the JAX_PLATFORMS env var, and touching a
+    # downed TPU tunnel hangs) — host-assembly timings are
+    # platform-independent, so the CPU run is the fallback mode.
+    plat = os.environ.get("PROFILE_PLATFORM")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except RuntimeError as e:
+            # Proceeding onto the default (possibly hung-tunnel TPU)
+            # backend is exactly what the flag exists to avoid.
+            sys.exit(f"PROFILE_PLATFORM={plat} could not be applied: {e}")
+
     jax.config.update(
         "jax_compilation_cache_dir",
         os.path.join(
