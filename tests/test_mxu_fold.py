@@ -1,0 +1,76 @@
+"""Fast-tier exactness for the MXU Montgomery fold (ops/tkernel.py
+_mont_fold_mxu — the two constant-Toeplitz matmuls replacing the CIOS
+fold on TPU).
+
+Off-TPU the fold defaults OFF because full-pipeline programs inlining
+thousands of its dot_generals explode the XLA:CPU compile (>90 GB
+compiler RSS measured on the fused batch verifier); at single-kernel
+scale it compiles in ~1 s, so this is where its CPU coverage lives —
+forced on via LHTPU_MXU_FOLD, interpret mode, bit-checked against the
+big-int oracle and against the CIOS path. bench.py's exactness gate and
+tests/test_tpu_parity.py re-pin it on real hardware.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+
+from lighthouse_tpu.ops import limb
+from lighthouse_tpu.ops import tkernel as tk
+
+T = 128  # one lane tile
+
+
+def _kernel(a_ref, b_ref, consts_ref, mont_ref, out_ref):
+    with tk.bound_consts(consts_ref[:], mont=mont_ref[:]):
+        out_ref[...] = tk.mont_mul_t(a_ref[:], b_ref[:])
+
+
+def _mont_mul_tile(a_t, b_t):
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((limb.N_LIMBS, T), jnp.int32),
+        interpret=True,
+    )(a_t, b_t, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
+
+
+def _rand_tile(rng):
+    ints = [rng.randrange(2 * limb.P) for _ in range(T)]
+    return ints, jnp.asarray(limb.ints_to_limbs(ints).T)  # [48, T]
+
+
+@pytest.mark.parametrize("fold", ["1", "0"])
+def test_mont_mul_exact_vs_oracle(monkeypatch, fold):
+    """Both fold schedules (MXU matmuls / CIOS loop) against the
+    big-int oracle across the full [0, 2p) lazy input domain."""
+    monkeypatch.setenv("LHTPU_MXU_FOLD", fold)
+    rng = random.Random(29 + int(fold))
+    a_ints, a_t = _rand_tile(rng)
+    b_ints, b_t = _rand_tile(rng)
+
+    got = np.asarray(_mont_mul_tile(a_t, b_t)).T  # [T, 48]
+    r_inv = pow(1 << limb.R_BITS, -1, limb.P)
+    for i in range(T):
+        gi = limb.limbs_to_int(got[i])
+        assert gi < 2 * limb.P, f"lane {i} violates [0,2p)"
+        assert gi % limb.P == (a_ints[i] * b_ints[i] * r_inv) % limb.P
+        assert (got[i] >= 0).all() and (got[i] <= 255).all()
+
+
+def test_fold_paths_bit_identical(monkeypatch):
+    """MXU fold output == CIOS fold output bit-for-bit (not just mod p):
+    downstream kernels assume one canonical [0,2p) representative
+    stream, so the schedules must agree exactly."""
+    rng = random.Random(31)
+    _, a_t = _rand_tile(rng)
+    _, b_t = _rand_tile(rng)
+
+    monkeypatch.setenv("LHTPU_MXU_FOLD", "1")
+    mxu = np.asarray(_mont_mul_tile(a_t, b_t))
+    monkeypatch.setenv("LHTPU_MXU_FOLD", "0")
+    cios = np.asarray(_mont_mul_tile(a_t, b_t))
+    assert np.array_equal(mxu, cios)
