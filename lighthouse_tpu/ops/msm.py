@@ -178,6 +178,7 @@ def _accum_t(gx, gy, valid, interpret: bool):
         in_specs=in_specs,
         out_specs=out_spec,
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(gx, gy, valid, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
 
 
@@ -271,6 +272,7 @@ def _f3_call(kernel, operand, interpret: bool):
         in_specs=in_specs,
         out_specs=pl.BlockSpec((3, 2, N_LIMBS, _LANES), lambda: (0, 0, 0, 0)),
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(operand, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
 
 

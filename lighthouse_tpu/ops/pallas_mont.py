@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tkernel as tk
 from .limb import LIMB_BITS, LIMB_MASK, N_LIMBS, NINV8, P, int_to_limbs
 
 TILE_T = 512  # batch elements (lanes) per grid step
@@ -99,6 +100,7 @@ def _mont_mul_flat(a, b, interpret: bool = False):
         out_specs=spec_in,
         scratch_shapes=[pltpu.VMEM((_ROWS, tile), jnp.int32)],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(at, bt, jnp.asarray(_P_COL))
     return jnp.transpose(out[:, :m] if m_pad != m else out)
 

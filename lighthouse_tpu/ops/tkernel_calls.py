@@ -122,6 +122,7 @@ def _scalar_mul_t(x, y, inf, bits, *, g2: bool, interpret: bool):
         in_specs=in_specs,
         out_specs=out_spec,
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(x, y, inf, bits, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
@@ -176,6 +177,7 @@ def _subgroup_check_g2(x, y, inf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((1,), True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(x, y, inf, _col(ORDER_BITS_NP), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, :t] != 0
 
@@ -243,6 +245,7 @@ def _subgroup_check_g2_fast(x, y, inf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((1,), True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(x, y, inf, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, :t] != 0
 
@@ -297,6 +300,7 @@ def _to_affine_t(P, *, g2: bool, interpret: bool):
         in_specs=in_specs,
         out_specs=tuple(out_specs),
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(stacked, _col(tk.PINV_BITS_NP), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[0, ..., :t], out[1, ..., :t], inf[0, :t] != 0
 
@@ -352,6 +356,7 @@ def _miller_t(xp, yp, pinf, xq, yq, qinf, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((2, 3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(xp, yp, pinf, xq, yq, qinf, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return out[..., :t]
 
@@ -431,6 +436,7 @@ def _f12_call(kernel, operands, extra_specs, extras, t, interpret):
         in_specs=in_specs,
         out_specs=_specs([(_F12_SHAPE, True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(*operands, *extras)
     return out[..., :t]
 

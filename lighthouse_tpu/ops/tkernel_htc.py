@@ -201,6 +201,7 @@ def _sswu_iso_t(u, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(u, _col(SQRT_RATIO_BITS), jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
@@ -281,6 +282,7 @@ def _cofactor_t(P, interpret: bool):
         in_specs=in_specs,
         out_specs=_specs([((3, 2, N_LIMBS), True)], tile)[0],
         interpret=interpret,
+        compiler_params=tk.vmem_params(),
     )(stacked, jnp.asarray(tk.CONSTS_NP), jnp.asarray(tk.MONT_MATS_NP))
     return tuple(out[i, ..., :t] for i in range(3))
 
