@@ -85,6 +85,34 @@ class NativeBackend:
         )
         return rc == 1
 
+    def g1_aggregate_rows(self, rows):
+        """Sum each row of G1 points; returns [(x_int, y_int, inf)] per row.
+
+        The CPU half of the device mixed-K path (reference: blst
+        aggregates each set's pubkeys on CPU before the multi-pairing,
+        impls/blst.rs:36-119). Points must be non-infinity (pubkeys past
+        key_validate); raises ValueError otherwise.
+        """
+        n = len(rows)
+        counts = (ctypes.c_uint32 * n)(*[len(r) for r in rows])
+        pks = b"".join(_pack_g1(p) for row in rows for p in row)
+        out = ctypes.create_string_buffer(n * 96)
+        rc = self._lib.lhbls_g1_aggregate_rows(pks, counts, n, out)
+        if rc != 1:
+            raise ValueError("invalid rows for g1 aggregation")
+        res = []
+        for i in range(n):
+            chunk = out.raw[i * 96 : (i + 1) * 96]
+            if chunk == bytes(96):
+                res.append((0, 0, True))
+            else:
+                res.append((
+                    int.from_bytes(chunk[:48], "big"),
+                    int.from_bytes(chunk[48:], "big"),
+                    False,
+                ))
+        return res
+
     # ------------------------------------------------------- test helpers
     def hash_to_g2_bytes(self, msg: bytes) -> tuple[bytes, bool]:
         out = ctypes.create_string_buffer(192)

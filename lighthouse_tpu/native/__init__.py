@@ -163,6 +163,11 @@ def load_lhbls():
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
                 ctypes.c_char_p,
             ]
+            lib.lhbls_g1_aggregate_rows.restype = ctypes.c_int
+            lib.lhbls_g1_aggregate_rows.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_uint64, ctypes.c_char_p,
+            ]
             from ..crypto.bls.constants import DST
 
             blob = _bls_const_blob()

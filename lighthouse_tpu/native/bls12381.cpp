@@ -922,6 +922,38 @@ extern "C" int lhbls_aggregate_verify(const uint8_t* pks, const uint8_t* msgs,
     return f12_is_one(final_exp(f)) ? 1 : 0;
 }
 
+// Per-set G1 pubkey aggregation (the CPU half of the mixed-K batch
+// path; mirrors impls/blst.rs:36-119 "aggregate that set's pubkeys
+// into one point" before the device multi-pairing).
+//   pks:    sum(counts)*96 bytes affine G1, concatenated in set order
+//           (no padding; all-zero = infinity -> invalid, key_validate)
+//   counts: n uint32 pubkey counts (0 -> invalid)
+//   out:    n*96 bytes affine aggregates (all-zero = infinity sum)
+// Returns 1 on success, 0 on any invalid input.
+extern "C" int lhbls_g1_aggregate_rows(const uint8_t* pks,
+                                       const uint32_t* counts, u64 n,
+                                       uint8_t* out) {
+    if (!READY || n == 0) return 0;
+    u64 off = 0;
+    for (u64 i = 0; i < n; i++) {
+        if (counts[i] == 0) return 0;
+        jac<fp> agg = pt_infinity<fp>();
+        for (u64 k = 0; k < counts[i]; k++, off++) {
+            aff<fp> pk = read_g1(pks + off * 96);
+            if (pk.inf) return 0;
+            agg = pt_add(agg, to_jac(pk));
+        }
+        aff<fp> a = to_affine(agg);
+        if (a.inf) {
+            for (int j = 0; j < 96; j++) out[i * 96 + j] = 0;
+        } else {
+            fp_to_be(a.x, out + i * 96);
+            fp_to_be(a.y, out + i * 96 + 48);
+        }
+    }
+    return 1;
+}
+
 // Single full pairing for tests: e(P, Q), output as 12 fp (standard bytes).
 extern "C" int lhbls_pairing(const uint8_t* g1_96, const uint8_t* g2_192,
                              uint8_t* out576) {
