@@ -66,6 +66,17 @@ from .ops.tower import fp12_is_one, fp12_mul
 from .utils import next_pow2 as _next_pow2
 
 
+def _try_load_native():
+    """The native C++ BLS backend, or None when the library can't load
+    (no compiler / build failure) — callers fall back to device paths."""
+    try:
+        from .crypto.bls.native_backend import load_native_backend
+
+        return load_native_backend()
+    except Exception:
+        return None
+
+
 def _fused_choice() -> str:
     """"1" -> fused Pallas kernels, "0" -> classic XLA. Fused is the TPU
     production path (3-5x the classic program); off-TPU Mosaic isn't
@@ -625,12 +636,7 @@ class JaxBackend:
             if est_native_ms < float(
                 os.environ.get("LHTPU_HOST_FALLBACK_MS", "250")
             ):
-                try:
-                    from .crypto.bls.native_backend import load_native_backend
-
-                    nb = load_native_backend()
-                except Exception:
-                    nb = None
+                nb = _try_load_native()
                 if nb is not None:
                     self.last_path = "native-fallback"
                     return bool(nb.verify_signature_sets(sets))
@@ -663,7 +669,24 @@ class JaxBackend:
         table_args = self._table_gather_args(sets, S, K)
 
         if table_args is None:
-            agg = self._host_aggregate_rows(sets) if K > 1 else None
+            # Host pubkey aggregation pays n*mean_K serial CPU point
+            # adds to collapse the grid to K=1; worth it only when the
+            # [S, K_pad] grid is mostly padding (mixed-K batches —
+            # measured 6.6x on BASELINE config #2 at max_K/mean_K 6.6).
+            # Uniform-K batches keep the device aggregation tree, and
+            # CPU test runs keep exercising it (TPU-gated like the
+            # native fallback above). LHTPU_HOST_AGG=0/1 overrides.
+            agg = None
+            host_agg = os.environ.get("LHTPU_HOST_AGG")
+            if K > 1 and (
+                host_agg == "1"
+                or (
+                    host_agg is None
+                    and jax.default_backend() == "tpu"
+                    and S * K >= 2 * total_keys
+                )
+            ):
+                agg = self._host_aggregate_rows(sets, S)
             if agg is not None:
                 # Mixed-K batches: per-set pubkey aggregation on the
                 # native CPU backend (exactly the reference's split —
@@ -675,7 +698,6 @@ class JaxBackend:
                 # parity-beating).
                 from .ops.points import _mont_batch
 
-                K = 1
                 px = _mont_batch([x for x, _, _ in agg]).reshape(S, 1, 48)
                 py = _mont_batch([y for _, y, _ in agg]).reshape(S, 1, 48)
                 pinf = np.asarray(
@@ -762,7 +784,35 @@ class JaxBackend:
             ok = fn((jnp.asarray(px), jnp.asarray(py)), jnp.asarray(pinf),
                     *tail, *msm_args)
             self.last_path = "fused" if choice == "1" else "classic"
+        if table_args is None and agg is not None:
+            self.last_path += "+host-agg"
         return ok
+
+    @staticmethod
+    def _host_aggregate_rows(sets, S: int):
+        """Per-set pubkey aggregation on the native CPU backend, padded
+        to ``S`` rows with infinity. Returns [(x_int, y_int, inf)] of
+        length S, or None when the native library is unavailable or a
+        set carries an infinity pubkey (the [S, K] grid path keeps the
+        device-side aggregation-tree semantics for those).
+
+        This is the CPU half of the reference's mixed-K split: blst
+        aggregates each set's keys on CPU, then runs one multi-pairing
+        (impls/blst.rs:36-119)."""
+        nb = _try_load_native()
+        if nb is None:
+            return None
+        rows = []
+        for s in sets:
+            pts = [pk.point for pk in s.signing_keys]
+            if any(p.infinity for p in pts):
+                return None
+            rows.append(pts)
+        try:
+            agg = nb.g1_aggregate_rows(rows)
+        except ValueError:
+            return None
+        return agg + [(0, 0, True)] * (S - len(sets))
 
     @staticmethod
     def _table_gather_args(sets, S: int, K: int):

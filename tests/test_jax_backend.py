@@ -114,3 +114,31 @@ def test_small_batch_routes_to_native_fallback(monkeypatch):
     bad = [sets[0], SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[1], M0)]
     assert not backend.verify_signature_sets(bad)
     assert backend.last_path == "native-fallback"
+
+
+def test_host_aggregation_collapses_mixed_k(monkeypatch):
+    """LHTPU_HOST_AGG=1 forces the mixed-K host-aggregation split (CPU
+    aggregates each set's keys, device gets a K=1 grid — the
+    impls/blst.rs:36-119 analog); verdicts must match the grid path."""
+    import lighthouse_tpu.jax_backend as jb
+
+    if jb._try_load_native() is None:
+        pytest.skip("native toolchain unavailable")
+
+    monkeypatch.setenv("LHTPU_HOST_AGG", "1")
+    monkeypatch.setenv("LHTPU_HOST_FALLBACK", "0")
+
+    backend = jb.JaxBackend()
+    sets = _valid_sets()
+    agg = backend._host_aggregate_rows(sets, 2)
+    assert len(agg) == 2 and not any(inf for _, _, inf in agg)
+    assert backend.verify_signature_sets(sets)
+    assert backend.last_path.endswith("+host-agg")
+
+    # tamper the 2-key set so the REJECTION rides the aggregated row
+    bad_agg = AggregateSignature.aggregate(
+        [SKS[1].sign(M1), SKS[2].sign(M0)]
+    )
+    bad = [sets[0], SignatureSet.multiple_pubkeys(bad_agg, [PKS[1], PKS[2]], M1)]
+    assert not backend.verify_signature_sets(bad)
+    assert backend.last_path.endswith("+host-agg")
