@@ -75,8 +75,14 @@ _IDX = {
     "ISO_XDEN": 39,    # rows 39-44 (3 x Fp2)
     "ISO_YNUM": 45,    # rows 45-52 (4 x Fp2)
     "ISO_YDEN": 53,    # rows 53-60 (4 x Fp2)
+    # Complements 2^384 - p / 2^384 - 2p: adding them replaces the
+    # signed subtractions (a - p, s - 2p) with nonnegative digit sums,
+    # which is what lets the Kogge-Stone carry path assume digits >= 0
+    # (binary carries) everywhere.
+    "COMP_P": 61,
+    "COMP_TWO_P": 62,
 }
-N_CONSTS = 61
+N_CONSTS = 63
 
 # MXU Montgomery-fold matrices (mont_mul_t): the full-width quotient
 # m = t_low * (-p^-1) mod 2^384 and the m*p add-back are constant
@@ -106,6 +112,8 @@ def _build_consts() -> np.ndarray:
     put("P", _limb.int_to_limbs(P))
     put("TWO_P", _limb.int_to_limbs(2 * P))
     put("R", _limb.int_to_limbs(_limb.R_MONT))
+    put("COMP_P", _limb.int_to_limbs((1 << 384) - P))
+    put("COMP_TWO_P", _limb.int_to_limbs((1 << 384) - 2 * P))
     for name in ("FROB6_C1", "FROB6_C2", "FROB12_C1"):
         pair = np.asarray(getattr(tower, name))  # [2, 48] lane-limb layout
         c[_IDX[name], :, 0] = pair[0]
@@ -305,21 +313,91 @@ def _carry_norm(t):
     return t, c  # rows rotated full circle: original order
 
 
+def _ks_enabled() -> bool:
+    """Kogge-Stone carry (log-depth) vs the serial scan-with-roll.
+    Default on; LHTPU_KS_CARRY=0 restores the serial chain."""
+    return _os.environ.get("LHTPU_KS_CARRY", "1") == "1"
+
+
+def _shift_rows(x, s: int, fill):
+    """Shift digits toward higher significance along the limb axis (-2):
+    out[i] = x[i - s], rows below s filled with ``fill``."""
+    pad = jnp.full((*x.shape[:-2], s, x.shape[-1]), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-s, :]], axis=-2)
+
+
+def _carry_norm_ks(t, bound: int):
+    """Log-depth carry propagation for NONNEGATIVE digits.
+
+    ``t``: int32[..., R, T] digits, each in [0, bound] (row 0 may carry
+    one extra +1 from a complement's trailing 1 — safe, row 0 never
+    receives a carry). Returns (normalized [0,255] digits, carry_out)
+    with carry_out = value >> (8*R), exactly like :func:`_carry_norm`
+    for nonnegative inputs.
+
+    Structure instead of a 48-step serial chain:
+    1. parallel byte-regroup passes until digits fit [0, 510]; carries
+       exiting the top row accumulate into ``c_out`` (value-preserving);
+    2. one Kogge-Stone prefix over (generate, propagate) bits — digits
+       <= 510 make every carry binary (d + c_in <= 511 < 512), with
+       g = d >= 256, p = d == 255 — six shift-combine steps for 48 rows.
+
+    Cost: every step is a full [R, T]-tile vector op; the serial chain
+    issues ~5 ops per row at 1-sublane utilization (measured v5e: 9.4
+    us vs ~2 us per instance at T=512).
+    """
+    c_out = jnp.zeros_like(t[..., 0, :])
+    while bound > 510:
+        two = bound >= (1 << (2 * LIMB_BITS))
+        lo = t & LIMB_MASK
+        if two:
+            c1 = (t >> LIMB_BITS) & LIMB_MASK
+            c2 = t >> (2 * LIMB_BITS)
+            t = lo + _shift_rows(c1, 1, 0) + _shift_rows(c2, 2, 0)
+            c_out = (
+                c_out
+                + c1[..., -1, :]
+                + c2[..., -2, :]
+                + (c2[..., -1, :] << LIMB_BITS)
+            )
+            bound = 255 + 255 + (bound >> (2 * LIMB_BITS))
+        else:
+            c1 = t >> LIMB_BITS
+            t = lo + _shift_rows(c1, 1, 0)
+            c_out = c_out + c1[..., -1, :]
+            bound = 255 + (bound >> LIMB_BITS)
+
+    g = t >= 256
+    p = t == 255
+    rows = t.shape[-2]
+    s = 1
+    while s < rows:
+        g = g | (p & _shift_rows(g, s, False))
+        p = p & _shift_rows(p, s, True)
+        s *= 2
+    c_in = _shift_rows(g, 1, False).astype(jnp.int32)
+    out = (t + c_in) & LIMB_MASK
+    return out, c_out + g[..., -1, :].astype(jnp.int32)
+
+
 def add_t(a, b):
     """(a + b) mod-ish, in [0, 2p) (limb.add semantics).
 
-    The sum and its 2p-reduction ride ONE stacked carry pass: the
-    sequential carry chain's cost is per-instruction, not per-row
-    (measured on v5e — a second stacked value is nearly free, two
-    chains cost double).
-
-    Correctness of carrying s-2p BEFORE s is normalized: limb-wise,
-    (a + b) - 2p has identical digit sums either way; carry
-    propagation is linear over the un-normalized digit vector.
+    The sum s and s - 2p ride ONE stacked carry pass; s - 2p is
+    computed as s + (2^384 - 2p) so both branches stay nonnegative
+    (COMP_TWO_P constant) and the stacked pass can use the Kogge-Stone
+    path. The d-branch carry bit IS the s >= 2p test.
     """
     s_raw = a + b
     shape = jnp.broadcast_shapes(s_raw.shape, _c("TWO_P").shape)
     s_raw = jnp.broadcast_to(s_raw, shape)
+    if _ks_enabled():
+        both, carries = _carry_norm_ks(
+            jnp.stack([s_raw, s_raw + _c("COMP_TWO_P")]), bound=765
+        )
+        s, d = both[0], both[1]
+        ge_2p = carries[1]
+        return jnp.where((ge_2p == 1)[..., None, :], d, s)
     both, carries = _carry_norm(
         jnp.stack([s_raw, s_raw - _c("TWO_P")])
     )
@@ -329,9 +407,21 @@ def add_t(a, b):
 
 
 def sub_t(a, b):
-    d_raw = a - b
-    shape = jnp.broadcast_shapes(d_raw.shape, _c("TWO_P").shape)
-    d_raw = jnp.broadcast_to(d_raw, shape)
+    """(a - b) mod-ish, in [0, 2p): a - b if a >= b else a - b + 2p.
+
+    KS path: a - b rides as the complement sum a + (2^384-1 - b) + 1
+    (digit-wise 255 - b, no borrows), whose carry bit is the a >= b
+    test; + 2p stacks alongside."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape, _c("TWO_P").shape)
+    if _ks_enabled():
+        base = jnp.broadcast_to(a + (LIMB_MASK - b), shape) + _c("ONE_STD")
+        both, carries = _carry_norm_ks(
+            jnp.stack([base, base + _c("TWO_P")]), bound=766
+        )
+        d2, d1 = both[0], both[1]
+        no_borrow = carries[0]
+        return jnp.where((no_borrow == 1)[..., None, :], d2, d1)
+    d_raw = jnp.broadcast_to(a - b, shape)
     both, carries = _carry_norm(
         jnp.stack([d_raw, d_raw + _c("TWO_P")])
     )
@@ -536,6 +626,13 @@ def mont_mul_t(a, b):
         f = _mont_fold_mxu(t)
         shape = jnp.broadcast_shapes(f.shape, _c("TWO_P").shape)
         f = jnp.broadcast_to(f, shape)
+        if _ks_enabled():
+            both, carries = _carry_norm_ks(
+                jnp.stack([f, f + _c("COMP_TWO_P")]), bound=(1 << 23) + 255
+            )
+            s, d = both[0], both[1]
+            ge_2p = carries[1]
+            return jnp.where((ge_2p == 1)[..., None, :], d, s)
         both, carries = _carry_norm(jnp.stack([f, f - _c("TWO_P")]))
         s, d = both[0], both[1]
         borrow = carries[1]
@@ -555,6 +652,9 @@ def mont_mul_t(a, b):
         )
 
     t = jax.lax.fori_loop(0, N_LIMBS, fold_step, t)
+    if _ks_enabled():
+        out, _ = _carry_norm_ks(t[..., :N_LIMBS, :], bound=(1 << 23) + 255)
+        return out
     out, _ = _carry_norm(t[..., :N_LIMBS, :])
     return out
 
@@ -593,6 +693,9 @@ def mont_inv_t(a):
 
 def canonical_t(a):
     """Reduce [0,2p) -> [0,p) for comparisons (limb.canonical)."""
+    if _ks_enabled():
+        d, carry = _carry_norm_ks(a + _c("COMP_P"), bound=510)
+        return jnp.where((carry == 1)[..., None, :], d, a)
     d, borrow = _carry_norm(a - _c("P"))
     return jnp.where((borrow == 0)[..., None, :], d, a)
 
