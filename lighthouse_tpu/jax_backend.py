@@ -91,6 +91,23 @@ def _fused_choice() -> str:
     return choice
 
 
+def _host_agg_wanted(K: int, S: int, total_keys: int) -> bool:
+    """Mixed-K host-aggregation heuristic: collapse the [S, K] pubkey
+    grid to K=1 via per-set CPU aggregation when the padded grid is
+    mostly padding waste (S*K >= 2 * real keys). TPU-only by default —
+    on CPU the device aggregation tree must keep its test coverage.
+    LHTPU_HOST_AGG=0/1 overrides. Factored out so the production
+    trigger (not just the override) is unit-testable (ADVICE r4)."""
+    import os
+
+    if K <= 1:
+        return False
+    host_agg = os.environ.get("LHTPU_HOST_AGG")
+    if host_agg is not None:
+        return host_agg == "1"
+    return jax.default_backend() == "tpu" and S * K >= 2 * total_keys
+
+
 def _pad_pair_lanes(g1_x, g1_y, g1_inf, g2_x, g2_y, g2_inf, pad: int):
     """Pad multi-pairing operands with ``pad`` inert lanes (replicate the
     last row's coordinates, mark the lane infinity -> contributes Fp12
@@ -668,6 +685,7 @@ class JaxBackend:
         # the gather happens inside the shard).
         table_args = self._table_gather_args(sets, S, K)
 
+        agg = None  # host-aggregated rows; set only on the non-table path
         if table_args is None:
             # Host pubkey aggregation pays n*mean_K serial CPU point
             # adds to collapse the grid to K=1; worth it only when the
@@ -676,16 +694,7 @@ class JaxBackend:
             # Uniform-K batches keep the device aggregation tree, and
             # CPU test runs keep exercising it (TPU-gated like the
             # native fallback above). LHTPU_HOST_AGG=0/1 overrides.
-            agg = None
-            host_agg = os.environ.get("LHTPU_HOST_AGG")
-            if K > 1 and (
-                host_agg == "1"
-                or (
-                    host_agg is None
-                    and jax.default_backend() == "tpu"
-                    and S * K >= 2 * total_keys
-                )
-            ):
+            if _host_agg_wanted(K, S, total_keys):
                 agg = self._host_aggregate_rows(sets, S)
             if agg is not None:
                 # Mixed-K batches: per-set pubkey aggregation on the

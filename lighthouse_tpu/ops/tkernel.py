@@ -315,8 +315,12 @@ def _carry_norm(t):
 
 def _ks_enabled() -> bool:
     """Kogge-Stone carry (log-depth) vs the serial scan-with-roll.
-    Default on; LHTPU_KS_CARRY=0 restores the serial chain."""
-    return _os.environ.get("LHTPU_KS_CARRY", "1") == "1"
+
+    Default OFF: with KS on, kernels traced under fori_loop bodies emit a
+    dynamic_slice that Mosaic cannot lower (r4 BENCH recorded 0.0 sets/s
+    with exactly that traceback). Re-enable with LHTPU_KS_CARRY=1 only
+    after tools/lowering_smoke.py passes on TPU with the flag set."""
+    return _os.environ.get("LHTPU_KS_CARRY", "0") == "1"
 
 
 def _shift_rows(x, s: int, fill):
@@ -345,7 +349,34 @@ def _carry_norm_ks(t, bound: int):
     Cost: every step is a full [R, T]-tile vector op; the serial chain
     issues ~5 ops per row at 1-sublane utilization (measured v5e: 9.4
     us vs ~2 us per instance at T=512).
+
+    NEGATIVE integer indices are forbidden in this function: jnp routes
+    them through dynamic_slice, which Mosaic does not lower (the r4
+    BENCH 0.0 regression); nonnegative static indices take the lax.slice
+    path and lower fine.
+
+    Call-site bound derivation (digits in [0, 255] pre-op):
+      add_t:        s = a+b stacked with s+COMP_TWO_P  -> 255+255+255 = 765
+      sub_t:        a+(255-b)+1 stacked with +TWO_P    -> 255+255+1+255 = 766
+      canonical_t:  a+COMP_P                           -> 255+255 = 510
+      mont_mul_t:   48-term convolution of 255*255 products (+fold adds)
+                    < 48*255*255 + slack               -> (1<<23)+255
+    Contract check: LHTPU_KS_CHECK=1 (test tiers) poisons the output on
+    any bound violation — eager inputs get a hard Python assert; traced
+    inputs get +341 on every digit (341 mod 256 != 0, so the corruption
+    survives the byte masks), which no oracle-comparison test can miss
+    (a silent near-miss is the failure mode this guards against).
     """
+    rows = t.shape[-2]
+    top = rows - 1
+    if _os.environ.get("LHTPU_KS_CHECK") == "1":
+        bad = jnp.any((t < 0) | (t > bound))
+        if not isinstance(bad, jax.core.Tracer):
+            assert not bool(bad), (
+                f"_carry_norm_ks: digits outside [0, {bound}]"
+            )
+        else:
+            t = t + bad.astype(t.dtype) * 341
     c_out = jnp.zeros_like(t[..., 0, :])
     while bound > 510:
         two = bound >= (1 << (2 * LIMB_BITS))
@@ -356,20 +387,19 @@ def _carry_norm_ks(t, bound: int):
             t = lo + _shift_rows(c1, 1, 0) + _shift_rows(c2, 2, 0)
             c_out = (
                 c_out
-                + c1[..., -1, :]
-                + c2[..., -2, :]
-                + (c2[..., -1, :] << LIMB_BITS)
+                + c1[..., top, :]
+                + c2[..., top - 1, :]
+                + (c2[..., top, :] << LIMB_BITS)
             )
             bound = 255 + 255 + (bound >> (2 * LIMB_BITS))
         else:
             c1 = t >> LIMB_BITS
             t = lo + _shift_rows(c1, 1, 0)
-            c_out = c_out + c1[..., -1, :]
+            c_out = c_out + c1[..., top, :]
             bound = 255 + (bound >> LIMB_BITS)
 
     g = t >= 256
     p = t == 255
-    rows = t.shape[-2]
     s = 1
     while s < rows:
         g = g | (p & _shift_rows(g, s, False))
@@ -377,7 +407,7 @@ def _carry_norm_ks(t, bound: int):
         s *= 2
     c_in = _shift_rows(g, 1, False).astype(jnp.int32)
     out = (t + c_in) & LIMB_MASK
-    return out, c_out + g[..., -1, :].astype(jnp.int32)
+    return out, c_out + g[..., top, :].astype(jnp.int32)
 
 
 def add_t(a, b):

@@ -142,3 +142,47 @@ def test_host_aggregation_collapses_mixed_k(monkeypatch):
     bad = [sets[0], SignatureSet.multiple_pubkeys(bad_agg, [PKS[1], PKS[2]], M1)]
     assert not backend.verify_signature_sets(bad)
     assert backend.last_path.endswith("+host-agg")
+
+
+def test_host_aggregation_heuristic_trigger(monkeypatch):
+    """The AUTOMATIC trigger (no LHTPU_HOST_AGG override): on a TPU
+    backend a mixed-K batch whose padded [S, K] grid is mostly waste
+    (S*K >= 2*total_keys) takes the host-agg split; uniform-K batches
+    keep the device aggregation tree (ADVICE r4: the production
+    condition was previously only exercised via the forced override)."""
+    import lighthouse_tpu.jax_backend as jb
+
+    monkeypatch.setattr(jb.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("LHTPU_HOST_AGG", raising=False)
+
+    # unit: the factored decision function
+    assert jb._host_agg_wanted(K=4, S=2, total_keys=4)  # mixed-K, wasteful
+    assert not jb._host_agg_wanted(K=8, S=4, total_keys=24)  # uniform-K
+    assert not jb._host_agg_wanted(K=1, S=64, total_keys=64)  # singles
+    monkeypatch.setenv("LHTPU_HOST_AGG", "0")
+    assert not jb._host_agg_wanted(K=4, S=2, total_keys=4)  # explicit off
+    monkeypatch.delenv("LHTPU_HOST_AGG")
+
+    if jb._try_load_native() is None:
+        pytest.skip("native toolchain unavailable")
+
+    # integration: a [1-key, 3-key] batch -> S=2, K=4, total=4 fires the
+    # heuristic; shapes collapse to the same (S=2, K=1) grid the forced
+    # test compiled, so this adds no new XLA compile bucket.
+    monkeypatch.setenv("LHTPU_HOST_FALLBACK", "0")
+    monkeypatch.setenv("LHTPU_FUSED_VERIFY", "0")
+    monkeypatch.setenv("LHTPU_SHARDED_VERIFY", "0")
+    monkeypatch.setenv("LHTPU_DEVICE_HTC", "0")  # no Mosaic on this host
+    sk3 = SecretKey.from_int(999)
+    agg3 = AggregateSignature.aggregate(
+        [SKS[1].sign(M1), SKS[2].sign(M1), sk3.sign(M1)]
+    )
+    sets = [
+        SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[0], M0),
+        SignatureSet.multiple_pubkeys(
+            agg3, [PKS[1], PKS[2], sk3.public_key()], M1
+        ),
+    ]
+    backend = jb.JaxBackend()
+    assert backend.verify_signature_sets(sets)
+    assert backend.last_path.endswith("+host-agg")

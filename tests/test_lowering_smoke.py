@@ -1,0 +1,43 @@
+"""Fast-tier Mosaic lowering smoke (ADVICE r4 medium).
+
+The CPU-only fast tier could not catch a TPU lowering regression: the
+fused Pallas path is TPU-gated and its digits are bit-exact on XLA:CPU,
+so the LHTPU_KS_CARRY=1 default that zeroed BENCH_r04 passed the whole
+suite clean. ``jax.export`` with ``platforms=['tpu']`` runs the real
+Pallas->Mosaic lowering pass on any host, so this test reproduces (and
+now prevents) that exact failure class from the fast tier.
+
+The full production kernel set is covered by ``tools/lowering_smoke.py``
+(fast <60 s / --full ~10 min); this test pins the cheapest kernel that
+still exercises every carry primitive (add/sub/canonical/mont_mul ride
+inside the G1 group law), under BOTH carry-path defaults and the
+production MXU-fold configuration.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.jax_backend import _rand_bits_array
+from lighthouse_tpu.ops import tkernel_calls as tc
+from lighthouse_tpu.ops.points import G1_GEN_DEV
+
+
+@pytest.mark.parametrize("ks", ["0", "1"])
+def test_scalar_mul_g1_lowers_for_tpu(monkeypatch, ks):
+    monkeypatch.setenv("LHTPU_KS_CARRY", ks)
+    # Production TPU traces run with the MXU fold on; lower that
+    # program, not the CPU conv fallback.
+    monkeypatch.setenv("LHTPU_MXU_FOLD", "1")
+
+    S = 128
+    g1x = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[0])[:, None], (48, S))
+    g1y = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[1])[:, None], (48, S))
+    inf_row = jnp.zeros((1, S), jnp.int32)
+    bits_t = jnp.transpose(jnp.asarray(_rand_bits_array(S)))
+
+    exp = jax.export.export(
+        jax.jit(lambda x, y, i, b: tc.scalar_mul_g1_t(x, y, i, b)),
+        platforms=["tpu"],
+    )(g1x, g1y, inf_row, bits_t)
+    assert exp.mlir_module()

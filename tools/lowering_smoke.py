@@ -1,0 +1,238 @@
+"""TPU lowering-smoke gate (VERDICT r4 item 2).
+
+Round 4 was lost to an env-default flip (LHTPU_KS_CARRY=1) that broke
+Mosaic lowering of every fused Pallas kernel — committed without ever
+compiling on TPU, invisible to the CPU-only fast tier. This gate makes
+that class of regression impossible to ship:
+
+  python tools/lowering_smoke.py            # fast set, <60 s
+  python tools/lowering_smoke.py --full     # every production kernel (~10 min)
+  python tools/lowering_smoke.py --run      # + execute one fused verify on TPU
+
+The trick: ``jax.export`` with ``platforms=['tpu']`` runs the FULL
+Pallas->Mosaic lowering pass (jax/_src/pallas/mosaic/lowering.py) on any
+host — no TPU needed. The exact NotImplementedError that zeroed
+BENCH_r04 reproduces in seconds on a 1-core CPU box. Each kernel is
+lowered under BOTH carry paths (LHTPU_KS_CARRY=0 and =1) so a default
+flip in either direction is covered.
+
+RULE (README "Lowering smoke" section): run the fast set before every
+commit that touches ops/ or jax_backend.py; run --full before flipping
+any kernel-affecting env default. The fast tier also runs the cheapest
+case as a pytest (tests/test_lowering_smoke.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_cases(full: bool):
+    """(name, build_fn, args) per production kernel, tiny shapes (S=128:
+    one lane tile). Import inside so env mutation precedes jax import."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.jax_backend import _rand_bits_array
+    from lighthouse_tpu.ops import tkernel_calls as tc
+    from lighthouse_tpu.ops.points import G1_GEN_DEV, G2_GEN_DEV
+
+    S = 128
+    g1x = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[0])[:, None], (48, S))
+    g1y = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[1])[:, None], (48, S))
+    g2x = jnp.broadcast_to(jnp.asarray(G2_GEN_DEV[0])[..., None], (2, 48, S))
+    g2y = jnp.broadcast_to(jnp.asarray(G2_GEN_DEV[1])[..., None], (2, 48, S))
+    inf_row = jnp.zeros((1, S), jnp.int32)
+    bits_t = jnp.transpose(jnp.asarray(_rand_bits_array(S)))
+
+    # Fast set: cheapest-to-trace kernels that still exercise every
+    # carry/mont-mul code path (add/sub/canonical/mont_mul ride inside
+    # the group law — Fp via the G1 ladder, Fp2 via the MSM mixed-add).
+    cases = [
+        ("scalar_mul_g1", lambda: tc.scalar_mul_g1_t(g1x, g1y, inf_row, bits_t)),
+    ]
+
+    def msm_accum():
+        from lighthouse_tpu.ops import msm as _msm
+
+        L = 8  # one grid step (schedule depth is padded to multiples of 8)
+        W = _msm._LANES
+        gx = jnp.broadcast_to(
+            jnp.asarray(G2_GEN_DEV[0])[None, ..., None], (L, 2, 48, W))
+        gy = jnp.broadcast_to(
+            jnp.asarray(G2_GEN_DEV[1])[None, ..., None], (L, 2, 48, W))
+        valid = jnp.ones((L, 1, W), jnp.int32)
+        return _msm._accum_t(gx, gy, valid, False)
+
+    cases.append(("msm_accum", msm_accum))
+
+    if full:
+        def sswu():
+            from lighthouse_tpu.ops.tkernel_htc import _sswu_iso_t
+
+            return _sswu_iso_t(g2x, False)
+
+        def cofactor():
+            from lighthouse_tpu.ops.tkernel_htc import _cofactor_t
+
+            jac2 = (g2x, g2y, jnp.broadcast_to(
+                jnp.concatenate(
+                    [jnp.asarray(tc.tk._c("R"))[None],
+                     jnp.zeros((1, 48, 1), jnp.int32)]
+                ),
+                (2, 48, S),
+            ))
+            return _cofactor_t(jac2, False)
+
+        def final_exp():
+            f = jnp.broadcast_to(
+                jnp.zeros((2, 3, 2, 48, 1), jnp.int32)
+                .at[0, 0, 0].set(tc.tk._c("R")),
+                (2, 3, 2, 48, S),
+            )
+            return tc.final_exp_kernel_t(f)
+
+        cases += [
+            ("scalar_mul_g2", lambda: tc.scalar_mul_g2_t(
+                g2x, g2y, inf_row, bits_t)),
+            ("subgroup_fast", lambda: tc.subgroup_check_g2_fast_t(
+                g2x, g2y, inf_row)),
+            ("to_affine_g1", lambda: tc.to_affine_g1_t(
+                (g1x, g1y, jnp.broadcast_to(tc.tk._c("R"), (48, S))))),
+            ("miller", lambda: tc.miller_loop_kernel_t(
+                (g1x, g1y), inf_row[0] != 0, (g2x, g2y), inf_row[0] != 0)),
+            ("sswu_iso", sswu),
+            ("cofactor", cofactor),
+            ("final_exp", final_exp),
+        ]
+    return cases
+
+
+def _lower_all(full: bool, ks: str) -> list[str]:
+    """Export-lower every case for platform 'tpu' in THIS process with
+    LHTPU_KS_CARRY=ks. Returns failure strings."""
+    os.environ["LHTPU_KS_CARRY"] = ks
+    # Mosaic lowering needs no device; force-exercise the TPU kernel
+    # path (interpret mode off) regardless of host platform.
+    os.environ.setdefault("LHTPU_MXU_FOLD", "1")
+
+    import jax
+
+    fails = []
+    for name, fn in _mk_cases(full):
+        t0 = time.time()
+        try:
+            jax.export.export(jax.jit(fn), platforms=["tpu"])()
+            print(f"  ks={ks} {name:16s} lowered OK ({time.time() - t0:.0f}s)",
+                  flush=True)
+        except Exception as e:
+            print(f"  ks={ks} {name:16s} FAILED: {str(e)[:160]}", flush=True)
+            fails.append(f"ks={ks} {name}: {str(e)[:200]}")
+    return fails
+
+
+def _run_fused_verify() -> list[str]:
+    """Execute one tiny fused verify on the attached TPU (the final
+    word: lowering AND Mosaic compile AND numerics). Uses the
+    persistent cache; a code change invalidates it, which is the
+    point."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return [f"--run requires a TPU backend (got {jax.default_backend()})"]
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache_tpu")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.jax_backend import (
+        _rand_scalars,
+        _verify_fused_jit,
+    )
+    from lighthouse_tpu.ops import msm as _msm
+    from lighthouse_tpu.ops.points import g1_to_dev, g2_to_dev
+
+    S = 4
+    sks = [SecretKey.from_int(i + 101) for i in range(S)]
+    msgs = [i.to_bytes(32, "big") for i in range(S)]
+    sets = [
+        SignatureSet.single_pubkey(sk.sign(m), sk.public_key(), m)
+        for sk, m in zip(sks, msgs)
+    ]
+    px, py, pinf = g1_to_dev([s.signing_keys[0].point for s in sets])
+    px, py = px.reshape(S, 1, 48), py.reshape(S, 1, 48)
+    pinf = pinf.reshape(S, 1)
+    sx, sy, sinf = g2_to_dev([s.signature.point for s in sets])
+    mx, my, minf = g2_to_dev([hash_to_g2(m) for m in msgs])
+    r_u64, r_bits = _rand_scalars(S)
+    args = (
+        (jnp.asarray(px), jnp.asarray(py)), jnp.asarray(pinf),
+        (jnp.asarray(sx), jnp.asarray(sy)), jnp.asarray(sinf),
+        (jnp.asarray(mx), jnp.asarray(my)), jnp.asarray(minf),
+        jnp.asarray(r_bits),
+    )
+    sched = _msm.build_schedule(r_u64, _msm.max_rounds(S))
+    if sched is not None:
+        args = args + (jnp.asarray(sched[0]), jnp.asarray(sched[1]))
+    t0 = time.time()
+    ok = bool(_verify_fused_jit(*args))
+    print(f"  fused verify S={S} on TPU: {ok} ({time.time() - t0:.0f}s)",
+          flush=True)
+    return [] if ok else ["fused verify returned False on TPU"]
+
+
+def main() -> int:
+    full = "--full" in sys.argv
+    run = "--run" in sys.argv
+    t0 = time.time()
+    fails: list[str] = []
+
+    # Each KS mode lowers in a fresh subprocess: tkernel's traced
+    # programs cache per-process, and env flips after first trace are
+    # exactly the bug class this gate exists to catch.
+    import subprocess
+
+    for ks in ("0", "1"):
+        print(f"[lowering-smoke] export-lower for TPU, LHTPU_KS_CARRY={ks}",
+              flush=True)
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child", ks] + (["--full"] if full else []),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=_REPO,
+        )
+        if r.returncode != 0:
+            fails.append(f"ks={ks}: child rc={r.returncode}")
+
+    if run and not fails:
+        print("[lowering-smoke] executing fused verify on TPU", flush=True)
+        fails += _run_fused_verify()
+
+    dt = time.time() - t0
+    if fails:
+        print(f"[lowering-smoke] FAILED in {dt:.0f}s:", flush=True)
+        for f in fails:
+            print(f"  - {f}", flush=True)
+        return 1
+    print(f"[lowering-smoke] PASS in {dt:.0f}s "
+          f"({'full' if full else 'fast'} set, ks=0+1"
+          f"{', fused verify run' if run else ''})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        ks = sys.argv[sys.argv.index("--child") + 1]
+        sys.exit(1 if _lower_all("--full" in sys.argv, ks) else 0)
+    sys.exit(main())
