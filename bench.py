@@ -77,10 +77,25 @@ _HEADLINE_EMITTED = False
 _INTENDED_RC = 0
 
 
+def _stage_report() -> dict | None:
+    """Per-stage attribution of the most recent BLS dispatch (stage wall
+    times, error counts, the stage the last failure raised in). Reads
+    the already-imported backend module only — a fallback line must not
+    trigger fresh imports mid-crash."""
+    try:
+        jb = sys.modules.get("lighthouse_tpu.jax_backend")
+        if jb is None:
+            return None
+        return jb.dispatch_stage_report()
+    except Exception:
+        return None
+
+
 def _emit_fallback(err: str) -> None:
     """The always-parseable last-resort JSON line (metric matches the
     mode actually being run, so a slot-mode failure doesn't record a
-    bogus 0.0 under the batch metric)."""
+    bogus 0.0 under the batch metric). A failure inside dispatch
+    carries its per-stage breakdown and named failing stage."""
     global _HEADLINE_EMITTED
     if _HEADLINE_EMITTED:
         return
@@ -90,13 +105,17 @@ def _emit_fallback(err: str) -> None:
     metric = ("chain_slot_attester_verifications_per_sec" if chain
               else "full_slot_attester_verifications_per_sec" if slot
               else "bls_sets_verified_per_sec")
-    print(json.dumps({
+    line = {
         "metric": metric,
         "value": 0.0,
         "unit": "attester-signatures/sec" if slot else "sets/sec",
         "vs_baseline": 0.0,
         "error": err[:400],
-    }), flush=True)
+    }
+    stages = _stage_report()
+    if stages is not None:
+        line["stages"] = stages
+    print(json.dumps(line), flush=True)
     _HEADLINE_EMITTED = True
 
 
@@ -154,6 +173,7 @@ def slot_chain_mode() -> None:
             "state_build_s": round(sc.state_build_s, 1),
             "chain_init_s": round(sc.chain_init_s, 1),
             "last_path": getattr(be, "last_path", None),
+            "stages": _stage_report(),
             "device": jax.devices()[0].platform,
         },
     }), flush=True)
@@ -294,6 +314,7 @@ def slot_mode() -> None:
             # DESIGN: registry keys enter the HBM table once at import
             # (validated there), per-slot verification ships indices.
             "pubkey_objects": "table-resident (deserialization at import)",
+            "stages": _stage_report(),
             "device": jax.devices()[0].platform,
         },
     }), flush=True)
@@ -563,7 +584,8 @@ def main() -> None:
     if not ok or (S > 1 and bad):
         print(json.dumps({"metric": "bls_sets_verified_per_sec", "value": 0.0,
                           "unit": "sets/sec", "vs_baseline": 0.0,
-                          "error": "exactness gate failed"}), flush=True)
+                          "error": "exactness gate failed",
+                          "stages": _stage_report()}), flush=True)
         _HEADLINE_EMITTED = True
         _INTENDED_RC = 1
         sys.exit(1)
@@ -593,6 +615,12 @@ def main() -> None:
     assert all(resolve() for resolve in pend)
     e2e_dt = (time.perf_counter() - t0) / REPS
     e2e_rate = S / e2e_dt
+
+    # Per-stage breakdown of the headline batch, captured NOW (before
+    # configs_mode dispatches overwrite the last-dispatch snapshot):
+    # pack / hash_to_curve / scalars / msm_schedule / dispatch /
+    # device_sync, plus error and jit-cache attribution.
+    headline_stages = _stage_report()
 
     # --- measured native CPU baseline (C++; BASELINE.md mandate) ------------
     detail = {
@@ -640,6 +668,8 @@ def main() -> None:
         except NameError:
             nb_handle = None
         configs_mode(backend, nb_handle)
+
+    detail["stages"] = headline_stages
 
     base = native_rate if native_rate else detail["cpu_python_sets_per_sec"]
     vs_target = _vs_target(e2e_rate, native_rate, detail)

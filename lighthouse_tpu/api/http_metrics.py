@@ -1,12 +1,16 @@
 """Prometheus scrape endpoint (reference: beacon_node/http_metrics +
 the VC's equivalent): serves the global registry's text exposition on
-`/metrics`, plus a bare liveness `/health`."""
+`/metrics`, a Chrome-trace dump of recent hot-path spans on `/trace`
+(load in chrome://tracing / ui.perfetto.dev), plus a bare liveness
+`/health`."""
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..common import tracing
 from ..common.metrics import REGISTRY
 
 
@@ -25,6 +29,12 @@ class MetricsServer:
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
                     )
+                elif self.path == "/trace":
+                    body = json.dumps(
+                        {"traceEvents": tracing.chrome_trace()}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif self.path == "/health":
                     body = b"OK"
                     self.send_response(200)

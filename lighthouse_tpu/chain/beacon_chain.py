@@ -228,6 +228,9 @@ class BeaconChain:
 
         state_root = bytes(block.state_root)
         ops_slot = self.current_slot()
+        # Slot-lateness of the import relative to the block's own slot
+        # (slot_clock_lateness_seconds{event="block_import"}).
+        self.slot_clock.record_lateness("block_import", int(block.slot))
         self.fork_choice.on_block(
             max(ops_slot, int(block.slot)),
             block,

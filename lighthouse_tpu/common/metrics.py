@@ -57,6 +57,14 @@ class Counter(Metric):
     def value(self, **labels) -> float:
         return self._values.get(self._label_key(labels), 0.0)
 
+    def items(self) -> list[tuple[dict, float]]:
+        """[(labels, value)] for every populated label set."""
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, k)), v)
+                for k, v in self._values.items()
+            ]
+
     def expose(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         values = self._values or ({(): 0.0} if not self.label_names else {})
@@ -86,6 +94,14 @@ class Gauge(Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(self._label_key(labels), 0.0)
+
+    def items(self) -> list[tuple[dict, float]]:
+        """[(labels, value)] for every populated label set."""
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, k)), v)
+                for k, v in self._values.items()
+            ]
 
     def expose(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]

@@ -8,6 +8,27 @@ from __future__ import annotations
 
 import time
 
+from .metrics import REGISTRY
+
+# Slot-position gauges (reference: slot_clock/src/metrics.rs PRESENT_SLOT
+# / SECONDS_FROM_CURRENT_SLOT_START): scraped alongside the dispatch
+# histograms so "verify took 300 ms" can be read against "that was
+# 4.1 s into the slot". Lateness observations are labelled by event so
+# block-import lateness and attestation lateness stay separable.
+SLOT_GAUGE = REGISTRY.gauge(
+    "slot_clock_slot", "Current slot per the local clock"
+)
+SLOT_SECONDS_INTO = REGISTRY.gauge(
+    "slot_clock_seconds_into_slot",
+    "Seconds elapsed since the current slot started",
+)
+SLOT_LATENESS_SECONDS = REGISTRY.histogram(
+    "slot_clock_lateness_seconds",
+    "How far past its slot's start an event was observed",
+    ("event",),
+    buckets=(0.5, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 24.0, 60.0),
+)
+
 
 class SlotClock:
     def __init__(self, genesis_time: int, seconds_per_slot: int):
@@ -19,7 +40,18 @@ class SlotClock:
         t = self._now_seconds()
         if t < self.genesis_time:
             return None
-        return int(t - self.genesis_time) // self.seconds_per_slot
+        slot = int(t - self.genesis_time) // self.seconds_per_slot
+        SLOT_GAUGE.set(slot)
+        SLOT_SECONDS_INTO.set(t - self.start_of(slot))
+        return slot
+
+    def record_lateness(self, event: str, slot: int) -> float:
+        """Observe (and return) how late ``event`` lands relative to the
+        start of ``slot`` — gossip/import callers tag their work so the
+        scrape shows whether verification keeps up with the slot clock."""
+        lateness = self._now_seconds() - self.start_of(slot)
+        SLOT_LATENESS_SECONDS.observe(lateness, event=event)
+        return lateness
 
     def slot_of(self, timestamp: float) -> int | None:
         if timestamp < self.genesis_time:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..common.metrics import REGISTRY
 from ..crypto.bls.api import AggregateSignature, PublicKey, Signature, SignatureSet
 from .config import ChainSpec, compute_signing_root
 from .hashing import hash32_concat
@@ -25,6 +26,15 @@ from .ssz import merkleize_chunks, uint64
 from .types import DepositMessage, SigningData
 
 GetPubkey = Callable[[int], Optional[PublicKey]]
+
+#: what the chain asks the BLS hot path to verify, by builder kind —
+#: pairs with bls_dispatch_batch_sets to show workload composition
+#: (reference: each signature_sets.rs caller has its own counter family)
+SETS_BUILT = REGISTRY.counter(
+    "bls_signature_sets_built_total",
+    "SignatureSets constructed, labelled by builder kind",
+    ("kind",),
+)
 
 
 class SignatureSetError(ValueError):
@@ -77,6 +87,7 @@ def block_proposal_signature_set(
         message = signing_root_of(block, domain)
     else:
         message = signing_root_of_root(block_root, domain)
+    SETS_BUILT.inc(kind="block_proposal")
     return SignatureSet.multiple_pubkeys(
         _sig(signed_block.signature),
         [_pk(get_pubkey, block.proposer_index)],
@@ -92,6 +103,7 @@ def randao_signature_set(
     domain = spec.get_domain(
         spec.DOMAIN_RANDAO, epoch, state.fork, state.genesis_validators_root
     )
+    SETS_BUILT.inc(kind="randao")
     return SignatureSet.multiple_pubkeys(
         _sig(block.body.randao_reveal),
         [_pk(get_pubkey, block.proposer_index)],
@@ -111,6 +123,7 @@ def proposer_slashing_signature_sets(
             spec.DOMAIN_BEACON_PROPOSER, epoch, state.fork,
             state.genesis_validators_root,
         )
+        SETS_BUILT.inc(kind="proposer_slashing")
         out.append(
             SignatureSet.multiple_pubkeys(
                 _sig(signed_header.signature),
@@ -130,6 +143,7 @@ def indexed_attestation_signature_set(
         state.genesis_validators_root,
     )
     pubkeys = [_pk(get_pubkey, i) for i in indexed.attesting_indices]
+    SETS_BUILT.inc(kind="indexed_attestation")
     return SignatureSet.multiple_pubkeys(
         _sig(signature), pubkeys, signing_root_of(indexed.data, domain),
         indices=[int(i) for i in indexed.attesting_indices],
@@ -165,6 +179,7 @@ def deposit_pubkey_signature_message(
         withdrawal_credentials=deposit_data.withdrawal_credentials,
         amount=deposit_data.amount,
     )
+    SETS_BUILT.inc(kind="deposit")
     return pk, sig, signing_root_of(msg, domain)
 
 
@@ -177,6 +192,7 @@ def exit_signature_set(
         spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch, state.fork,
         state.genesis_validators_root,
     )
+    SETS_BUILT.inc(kind="exit")
     return SignatureSet.multiple_pubkeys(
         _sig(signed_exit.signature),
         [_pk(get_pubkey, exit_msg.validator_index)],
@@ -195,6 +211,7 @@ def signed_aggregate_selection_proof_signature_set(
         spec.DOMAIN_SELECTION_PROOF, epoch, state.fork,
         state.genesis_validators_root,
     )
+    SETS_BUILT.inc(kind="aggregate_selection_proof")
     return SignatureSet.multiple_pubkeys(
         _sig(message.selection_proof),
         [_pk(get_pubkey, message.aggregator_index)],
@@ -212,6 +229,7 @@ def signed_aggregate_signature_set(
         spec.DOMAIN_AGGREGATE_AND_PROOF, epoch, state.fork,
         state.genesis_validators_root,
     )
+    SETS_BUILT.inc(kind="aggregate")
     return SignatureSet.multiple_pubkeys(
         _sig(signed_aggregate.signature),
         [_pk(get_pubkey, message.aggregator_index)],
@@ -244,6 +262,7 @@ def sync_aggregate_signature_set(
     pubkeys = [_pk(get_pubkey, i) for i in participant_indices]
     if not pubkeys and sig.is_infinity():
         return None  # spec: empty participation + infinity sig is valid
+    SETS_BUILT.inc(kind="sync_aggregate")
     return SignatureSet.multiple_pubkeys(
         sig, pubkeys, signing_root_of_root(block_root, domain)
     )
@@ -258,6 +277,7 @@ def sync_committee_message_set(
         spec.DOMAIN_SYNC_COMMITTEE, epoch, state.fork,
         state.genesis_validators_root,
     )
+    SETS_BUILT.inc(kind="sync_committee_message")
     return SignatureSet.multiple_pubkeys(
         _sig(message.signature),
         [_pk(get_pubkey, message.validator_index)],
@@ -280,6 +300,7 @@ def sync_committee_contribution_signature_set(
     pubkeys = [_pk(get_pubkey, i) for i in participant_indices]
     if not pubkeys and sig.is_infinity():
         return None
+    SETS_BUILT.inc(kind="sync_contribution")
     return SignatureSet.multiple_pubkeys(
         sig, pubkeys,
         signing_root_of_root(bytes(contribution.beacon_block_root), domain),
@@ -303,6 +324,7 @@ def sync_committee_selection_proof_signature_set(
     selection_data = SyncAggregatorSelectionData(
         slot=slot, subcommittee_index=int(contribution.subcommittee_index)
     )
+    SETS_BUILT.inc(kind="sync_selection_proof")
     return SignatureSet.multiple_pubkeys(
         _sig(contribution_and_proof.selection_proof),
         [_pk(get_pubkey, int(contribution_and_proof.aggregator_index))],
@@ -321,6 +343,7 @@ def signed_contribution_and_proof_signature_set(
         spec.DOMAIN_CONTRIBUTION_AND_PROOF, epoch, state.fork,
         state.genesis_validators_root,
     )
+    SETS_BUILT.inc(kind="contribution_and_proof")
     return SignatureSet.multiple_pubkeys(
         _sig(signed_contribution.signature),
         [_pk(get_pubkey, int(message.aggregator_index))],

@@ -20,6 +20,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..common import tracing
+from ..common.metrics import REGISTRY
 from ..consensus.config import ChainSpec
 from .proto_array import (
     ExecutionStatus,
@@ -30,6 +32,27 @@ from .proto_array import (
 
 SAFE_SLOTS_TO_UPDATE_JUSTIFIED = 8
 ZERO_ROOT = b"\x00" * 32
+
+# Fork-choice op timers (reference: beacon_chain/src/metrics.rs
+# FORK_CHOICE_*_TIMES) — get_head sits on the block-production and
+# attestation hot paths, so its latency distribution matters.
+FORK_CHOICE_OP_SECONDS = REGISTRY.histogram(
+    "fork_choice_op_seconds",
+    "Wall time of fork-choice operations",
+    ("op",),
+)
+FORK_CHOICE_QUEUED_ATTESTATIONS = REGISTRY.gauge(
+    "fork_choice_queued_attestations",
+    "Attestations queued for the next slot",
+)
+
+
+def _fc_span(op: str):
+    return tracing.span(
+        "fork_choice/" + op,
+        metric=FORK_CHOICE_OP_SECONDS,
+        labels={"op": op},
+    )
 
 
 class ForkChoiceError(ValueError):
@@ -185,6 +208,25 @@ class ForkChoice:
     ) -> None:
         """Register an imported block (reference: fork_choice.rs:623).
         ``state`` is the post-state of the block."""
+        with _fc_span("on_block"):
+            self._on_block_inner(
+                current_slot, block, block_root, state,
+                block_delay_seconds=block_delay_seconds,
+                execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
+            )
+
+    def _on_block_inner(
+        self,
+        current_slot: int,
+        block,
+        block_root: bytes,
+        state,
+        *,
+        block_delay_seconds: float | None = None,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+        execution_block_hash: bytes | None = None,
+    ) -> None:
         from ..consensus import helpers as h
 
         self.update_time(current_slot)
@@ -275,23 +317,31 @@ class ForkChoice:
     ) -> None:
         """Apply an indexed attestation's LMD votes
         (reference: fork_choice.rs:918)."""
-        self.update_time(current_slot)
-        data = indexed_attestation.data
-        self._validate_on_attestation(current_slot, data, is_from_block)
-        if int(data.slot) < current_slot:
-            for index in indexed_attestation.attesting_indices:
-                if int(index) not in self.store.equivocating_indices:
-                    self.proto.process_attestation(
-                        int(index), bytes(data.beacon_block_root), int(data.target.epoch)
+        with _fc_span("on_attestation"):
+            self.update_time(current_slot)
+            data = indexed_attestation.data
+            self._validate_on_attestation(current_slot, data, is_from_block)
+            if int(data.slot) < current_slot:
+                for index in indexed_attestation.attesting_indices:
+                    if int(index) not in self.store.equivocating_indices:
+                        self.proto.process_attestation(
+                            int(index), bytes(data.beacon_block_root),
+                            int(data.target.epoch),
+                        )
+            else:
+                self.queued_attestations.append(
+                    QueuedAttestation(
+                        slot=int(data.slot),
+                        attesting_indices=[
+                            int(i)
+                            for i in indexed_attestation.attesting_indices
+                        ],
+                        block_root=bytes(data.beacon_block_root),
+                        target_epoch=int(data.target.epoch),
                     )
-        else:
-            self.queued_attestations.append(
-                QueuedAttestation(
-                    slot=int(data.slot),
-                    attesting_indices=[int(i) for i in indexed_attestation.attesting_indices],
-                    block_root=bytes(data.beacon_block_root),
-                    target_epoch=int(data.target.epoch),
                 )
+            FORK_CHOICE_QUEUED_ATTESTATIONS.set(
+                len(self.queued_attestations)
             )
 
     def _validate_on_attestation(self, current_slot: int, data, is_from_block: bool) -> None:
@@ -332,15 +382,16 @@ class ForkChoice:
     def get_head(self, current_slot: int) -> bytes:
         """Run find_head from the justified checkpoint
         (reference: fork_choice.rs:471)."""
-        self.update_time(current_slot)
-        return self.proto.find_head(
-            self.store.justified_checkpoint,
-            self.store.finalized_checkpoint,
-            self.store.justified_balances,
-            self.store.proposer_boost_root,
-            current_slot,
-            self.spec,
-        )
+        with _fc_span("get_head"):
+            self.update_time(current_slot)
+            return self.proto.find_head(
+                self.store.justified_checkpoint,
+                self.store.finalized_checkpoint,
+                self.store.justified_balances,
+                self.store.proposer_boost_root,
+                current_slot,
+                self.spec,
+            )
 
     # ----------------------------------------------------------- execution
     def on_valid_execution_payload(self, root: bytes) -> None:

@@ -36,11 +36,15 @@ device-PRNG'd into the traced graph.
 from __future__ import annotations
 
 import secrets
+import time
+from contextlib import contextmanager
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .common import tracing
+from .common.metrics import REGISTRY
 from .crypto.bls.backends import register_backend
 from .crypto.bls.constants import RAND_BITS
 from .crypto.bls.hash_to_curve import hash_to_g2
@@ -64,6 +68,123 @@ from .ops.tower import fp12_is_one, fp12_mul
 
 
 from .utils import next_pow2 as _next_pow2
+
+
+# --- dispatch observability (the per-crate metrics.rs of this module) ----
+# Every stage of _dispatch is a tracing span mirrored into these
+# families; bench.py and tools read the same data through
+# dispatch_stage_report(). Names follow the reference's
+# beacon_node metric style (lighthouse_metrics).
+
+_POW2_BUCKETS = tuple(float(1 << i) for i in range(14))  # 1..8192
+
+DISPATCH_STAGE_SECONDS = REGISTRY.histogram(
+    "bls_dispatch_stage_seconds",
+    "Host wall time of each BLS dispatch stage",
+    ("stage",),
+)
+DISPATCH_ERRORS = REGISTRY.counter(
+    "bls_dispatch_errors_total",
+    "Failures inside BLS dispatch, attributed to the stage that raised",
+    ("stage",),
+)
+DISPATCH_BATCHES = REGISTRY.counter(
+    "bls_dispatch_batches_total",
+    "Verification batches dispatched, by device program path",
+    ("path",),
+)
+DISPATCH_BATCH_SETS = REGISTRY.histogram(
+    "bls_dispatch_batch_sets",
+    "Signature sets per dispatched batch (pre-padding)",
+    buckets=_POW2_BUCKETS,
+)
+DISPATCH_BATCH_KEYS = REGISTRY.histogram(
+    "bls_dispatch_batch_keys",
+    "Total signing keys per dispatched batch (pre-padding)",
+    buckets=_POW2_BUCKETS,
+)
+JIT_CACHE_EVENTS = REGISTRY.counter(
+    "bls_jit_cache_events_total",
+    "Verify-program jit dispatches by compile-cache outcome",
+    ("fn", "event"),
+)
+
+# Most recent dispatch's stage timings / failure, for bench attribution
+# (bench.py reads these through dispatch_stage_report even when the
+# dispatch died mid-flight).
+_LAST_STAGES: dict[str, float] = {}
+_LAST_ERROR_STAGE: str | None = None
+
+
+@contextmanager
+def _stage(name: str, stages: dict):
+    """One dispatch stage: tracing span + histogram mirror + loud error
+    attribution. With tracing off only the (exception-path) error
+    counter remains — no clock reads on the measured path."""
+    global _LAST_ERROR_STAGE
+    if not tracing.enabled():
+        try:
+            yield
+        except Exception:
+            _LAST_ERROR_STAGE = name
+            DISPATCH_ERRORS.inc(stage=name)
+            raise
+        return
+    t0 = time.perf_counter()
+    try:
+        with tracing.span(
+            "bls_dispatch/" + name,
+            metric=DISPATCH_STAGE_SECONDS,
+            labels={"stage": name},
+        ):
+            yield
+    except Exception:
+        _LAST_ERROR_STAGE = name
+        DISPATCH_ERRORS.inc(stage=name)
+        raise
+    stages[name] = time.perf_counter() - t0
+
+
+def _jit_cache_probe(fn, label: str):
+    """Sample ``fn``'s jit cache size; returns a closure that, called
+    after the dispatch, records hit vs miss (a growth in cache size is
+    a fresh trace/compile). Counts nothing when the runtime doesn't
+    expose _cache_size (non-jit callables, older jax)."""
+    try:
+        before = fn._cache_size()
+    except Exception:
+        return lambda: None
+
+    def done():
+        try:
+            after = fn._cache_size()
+        except Exception:
+            return
+        JIT_CACHE_EVENTS.inc(
+            fn=label, event="miss" if after > before else "hit"
+        )
+
+    return done
+
+
+def dispatch_stage_report() -> dict:
+    """Stage attribution of the most recent _dispatch: per-stage wall
+    times, cumulative per-stage error counts, and the stage the last
+    failure raised in (None = no failure yet). The bench embeds this in
+    its JSON so a dead run still names the guilty stage."""
+    return {
+        "stages_ms": {
+            k: round(v * 1e3, 3) for k, v in _LAST_STAGES.items()
+        },
+        "failed_stage": _LAST_ERROR_STAGE,
+        "errors_total": {
+            lbl["stage"]: v for lbl, v in DISPATCH_ERRORS.items()
+        },
+        "jit_cache": {
+            f"{lbl['fn']}:{lbl['event']}": v
+            for lbl, v in JIT_CACHE_EVENTS.items()
+        },
+    }
 
 
 def _try_load_native():
@@ -544,6 +665,10 @@ class JaxBackend:
     # "sharded" | "indexed" | "fused" | "classic") — introspection for
     # tests and ops debugging.
     last_path: str | None = None
+    # Stage -> seconds of the most recent _dispatch (same data as the
+    # bls_dispatch_stage_seconds histogram, but per-call — bench.py's
+    # per-stage breakdown). Empty when tracing is disabled.
+    last_stage_seconds: dict = {}
 
     @staticmethod
     def _use_device_htc() -> bool:
@@ -600,7 +725,12 @@ class JaxBackend:
 
     def verify_signature_sets(self, sets) -> bool:
         out = self._dispatch(sets)
-        return out if isinstance(out, bool) else bool(out)
+        if isinstance(out, bool):
+            return out
+        # Forcing the device scalar is where async dispatch errors and
+        # device wall time surface — its own attributed stage.
+        with _stage("device_sync", self.last_stage_seconds):
+            return bool(out)
 
     def verify_signature_sets_async(self, sets):
         """Dispatch the batch and return a zero-arg resolver.
@@ -617,11 +747,28 @@ class JaxBackend:
         out = self._dispatch(sets)
         if isinstance(out, bool):
             return lambda: out
-        return lambda: bool(out)
+        stages = self.last_stage_seconds
+
+        def resolve() -> bool:
+            with _stage("device_sync", stages):
+                return bool(out)
+
+        return resolve
 
     def _dispatch(self, sets):
         """Common assembly + device dispatch; returns a host bool (for
-        structural rejections) or the un-forced device verdict scalar."""
+        structural rejections) or the un-forced device verdict scalar.
+
+        Every phase runs inside an attributed stage (pack /
+        hash_to_curve / scalars / msm_schedule / dispatch, plus
+        device_sync at the force point): wall time lands in
+        bls_dispatch_stage_seconds, a failure increments
+        bls_dispatch_errors_total{stage=...} and is named in
+        dispatch_stage_report() instead of being swallowed."""
+        global _LAST_STAGES
+        stages: dict[str, float] = {}
+        _LAST_STAGES = stages
+        self.last_stage_seconds = stages
         if not sets:
             return False
         # Host-side structural rejections (reference: impls/blst.rs:79-88).
@@ -635,6 +782,8 @@ class JaxBackend:
 
         n = len(sets)
         total_keys = sum(len(s.signing_keys) for s in sets)
+        DISPATCH_BATCH_SETS.observe(n)
+        DISPATCH_BATCH_KEYS.observe(total_keys)
 
         # Small-batch host fallback (SURVEY §7.3: "keep a host CPU
         # fallback path for singletons"): device dispatch latency
@@ -656,7 +805,9 @@ class JaxBackend:
                 nb = _try_load_native()
                 if nb is not None:
                     self.last_path = "native-fallback"
-                    return bool(nb.verify_signature_sets(sets))
+                    DISPATCH_BATCHES.inc(path="native-fallback")
+                    with _stage("native_fallback", stages):
+                        return bool(nb.verify_signature_sets(sets))
 
         S = _next_pow2(n)
         K = _next_pow2(max(len(s.signing_keys) for s in sets))
@@ -679,122 +830,144 @@ class JaxBackend:
 
         inf1, inf2 = g1_infinity(), g2_infinity()
 
-        # HBM-table fast path: every set carries validator indices the
-        # device table covers -> gather on device, no coordinate upload.
-        # Composes with sharding (the table is replicated per chip and
-        # the gather happens inside the shard).
-        table_args = self._table_gather_args(sets, S, K)
+        with _stage("pack", stages):
+            # HBM-table fast path: every set carries validator indices the
+            # device table covers -> gather on device, no coordinate
+            # upload. Composes with sharding (the table is replicated per
+            # chip and the gather happens inside the shard).
+            table_args = self._table_gather_args(sets, S, K)
 
-        agg = None  # host-aggregated rows; set only on the non-table path
-        if table_args is None:
-            # Host pubkey aggregation pays n*mean_K serial CPU point
-            # adds to collapse the grid to K=1; worth it only when the
-            # [S, K_pad] grid is mostly padding (mixed-K batches —
-            # measured 6.6x on BASELINE config #2 at max_K/mean_K 6.6).
-            # Uniform-K batches keep the device aggregation tree, and
-            # CPU test runs keep exercising it (TPU-gated like the
-            # native fallback above). LHTPU_HOST_AGG=0/1 overrides.
-            if _host_agg_wanted(K, S, total_keys):
-                agg = self._host_aggregate_rows(sets, S)
-            if agg is not None:
-                # Mixed-K batches: per-set pubkey aggregation on the
-                # native CPU backend (exactly the reference's split —
-                # blst aggregates each set's keys on CPU, then one
-                # multi-pairing: impls/blst.rs:36-119). Shipping a K=1
-                # grid replaces an [S, K_pad] grid whose padding waste
-                # is max_K/mean_K (measured 6.6x on BASELINE config #2,
-                # where this path took the device from 0.84x native to
-                # parity-beating).
-                from .ops.points import _mont_batch
+            agg = None  # host-aggregated rows; only on the non-table path
+            if table_args is None:
+                # Host pubkey aggregation pays n*mean_K serial CPU point
+                # adds to collapse the grid to K=1; worth it only when the
+                # [S, K_pad] grid is mostly padding (mixed-K batches —
+                # measured 6.6x on BASELINE config #2 at max_K/mean_K 6.6).
+                # Uniform-K batches keep the device aggregation tree, and
+                # CPU test runs keep exercising it (TPU-gated like the
+                # native fallback above). LHTPU_HOST_AGG=0/1 overrides.
+                if _host_agg_wanted(K, S, total_keys):
+                    agg = self._host_aggregate_rows(sets, S)
+                if agg is not None:
+                    # Mixed-K batches: per-set pubkey aggregation on the
+                    # native CPU backend (exactly the reference's split —
+                    # blst aggregates each set's keys on CPU, then one
+                    # multi-pairing: impls/blst.rs:36-119). Shipping a K=1
+                    # grid replaces an [S, K_pad] grid whose padding waste
+                    # is max_K/mean_K (measured 6.6x on BASELINE config
+                    # #2, where this path took the device from 0.84x
+                    # native to parity-beating).
+                    from .ops.points import _mont_batch
 
-                px = _mont_batch([x for x, _, _ in agg]).reshape(S, 1, 48)
-                py = _mont_batch([y for _, y, _ in agg]).reshape(S, 1, 48)
-                pinf = np.asarray(
-                    [i for _, _, i in agg], dtype=bool
-                ).reshape(S, 1)
-            else:
-                # Pubkeys: [S, K] affine grid, padding lanes at infinity.
-                pk_rows = []
-                for s in sets:
-                    row = [pk.point for pk in s.signing_keys]
-                    row += [inf1] * (K - len(row))
-                    pk_rows.append(row)
-                pk_rows += [[inf1] * K] * (S - n)
-                flat = [p for row in pk_rows for p in row]
-                px, py, pinf = g1_to_dev(flat)
-                px, py = px.reshape(S, K, 48), py.reshape(S, K, 48)
-                pinf = pinf.reshape(S, K)
+                    px = _mont_batch(
+                        [x for x, _, _ in agg]
+                    ).reshape(S, 1, 48)
+                    py = _mont_batch(
+                        [y for _, y, _ in agg]
+                    ).reshape(S, 1, 48)
+                    pinf = np.asarray(
+                        [i for _, _, i in agg], dtype=bool
+                    ).reshape(S, 1)
+                else:
+                    # Pubkeys: [S, K] affine grid, padding at infinity.
+                    pk_rows = []
+                    for s in sets:
+                        row = [pk.point for pk in s.signing_keys]
+                        row += [inf1] * (K - len(row))
+                        pk_rows.append(row)
+                    pk_rows += [[inf1] * K] * (S - n)
+                    flat = [p for row in pk_rows for p in row]
+                    px, py, pinf = g1_to_dev(flat)
+                    px, py = px.reshape(S, K, 48), py.reshape(S, K, 48)
+                    pinf = pinf.reshape(S, K)
 
-        sigs = [s.signature.point for s in sets] + [inf2] * (S - n)
-        sx, sy, sinf = g2_to_dev(sigs)
+            sigs = [s.signature.point for s in sets] + [inf2] * (S - n)
+            sx, sy, sinf = g2_to_dev(sigs)
 
-        mx, my, minf = self._hash_messages(sets, S, inf2)
+        with _stage("hash_to_curve", stages):
+            mx, my, minf = self._hash_messages(sets, S, inf2)
 
-        r_u64, r_bits = _rand_scalars(S)
+        with _stage("scalars", stages):
+            r_u64, r_bits = _rand_scalars(S)
 
         # Bucketed-MSM schedule for the RLC signature accumulator
         # (host-side — the scalars are host CSPRNG output; ops/msm.py).
         # None -> the cores keep their per-lane scalar-mul scan.
-        msm_sched = None
-        if choice == "1" and os.environ.get("LHTPU_MSM_VERIFY", "1") == "1":
-            from .ops import msm as _msm
+        with _stage("msm_schedule", stages):
+            msm_sched = None
+            if choice == "1" and os.environ.get("LHTPU_MSM_VERIFY", "1") == "1":
+                from .ops import msm as _msm
 
-            skip = np.arange(S) >= n
-            if use_sharded:
-                L = _msm.max_rounds(S // n_dev)
-                msm_sched = _msm.build_schedule_sharded(r_u64, L, n_dev, skip)
+                skip = np.arange(S) >= n
+                if use_sharded:
+                    L = _msm.max_rounds(S // n_dev)
+                    msm_sched = _msm.build_schedule_sharded(
+                        r_u64, L, n_dev, skip
+                    )
+                else:
+                    msm_sched = _msm.build_schedule(
+                        r_u64, _msm.max_rounds(S), skip
+                    )
+
+        # Transfer + async enqueue (a jit-cache miss makes this stage the
+        # trace+compile — bls_jit_cache_events_total disambiguates).
+        with _stage("dispatch", stages):
+            msm_args = (
+                ()
+                if msm_sched is None
+                else (jnp.asarray(msm_sched[0]), jnp.asarray(msm_sched[1]))
+            )
+            tail = (
+                (jnp.asarray(sx), jnp.asarray(sy)),
+                jnp.asarray(sinf),
+                (jnp.asarray(mx), jnp.asarray(my)),
+                jnp.asarray(minf),
+                jnp.asarray(r_bits),
+            )
+            if use_sharded and table_args is not None:
+                # All three fast paths composed: HBM-table gather +
+                # shard_map over a ("dp",) mesh + fused kernels.
+                tx, ty, idx, pinf = table_args
+                fn = _sharded_fused_fn(n_dev, indexed=True,
+                                       with_msm=bool(msm_args))
+                probe = _jit_cache_probe(fn, "sharded-indexed")
+                ok = fn(
+                    tx, ty, jnp.asarray(idx), jnp.asarray(pinf),
+                    tail[0][0], tail[0][1], tail[1],
+                    tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
+                )[0]
+                self.last_path = "sharded-indexed"
+            elif use_sharded:
+                # One code path to N chips: the fused core inside
+                # shard_map over a ("dp",) mesh (parallel/sharding.py).
+                fn = _sharded_fused_fn(n_dev, with_msm=bool(msm_args))
+                probe = _jit_cache_probe(fn, "sharded")
+                ok = fn(
+                    jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
+                    tail[0][0], tail[0][1], tail[1],
+                    tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
+                )[0]
+                self.last_path = "sharded"
+            elif table_args is not None:
+                tx, ty, idx, pinf = table_args
+                fn = (_verify_fused_indexed_jit if choice == "1"
+                      else _verify_indexed_jit)
+                probe = _jit_cache_probe(fn, "indexed")
+                ok = fn(tx, ty, jnp.asarray(idx), jnp.asarray(pinf), *tail,
+                        *msm_args)
+                self.last_path = "indexed"
             else:
-                msm_sched = _msm.build_schedule(
-                    r_u64, _msm.max_rounds(S), skip
+                fn = _verify_fused_jit if choice == "1" else _verify_jit
+                probe = _jit_cache_probe(
+                    fn, "fused" if choice == "1" else "classic"
                 )
-        msm_args = (
-            ()
-            if msm_sched is None
-            else (jnp.asarray(msm_sched[0]), jnp.asarray(msm_sched[1]))
-        )
-
-        tail = (
-            (jnp.asarray(sx), jnp.asarray(sy)),
-            jnp.asarray(sinf),
-            (jnp.asarray(mx), jnp.asarray(my)),
-            jnp.asarray(minf),
-            jnp.asarray(r_bits),
-        )
-        if use_sharded and table_args is not None:
-            # All three fast paths composed: HBM-table gather + shard_map
-            # over a ("dp",) mesh + fused kernels.
-            tx, ty, idx, pinf = table_args
-            fn = _sharded_fused_fn(n_dev, indexed=True,
-                                   with_msm=bool(msm_args))
-            ok = fn(
-                tx, ty, jnp.asarray(idx), jnp.asarray(pinf),
-                tail[0][0], tail[0][1], tail[1],
-                tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
-            )[0]
-            self.last_path = "sharded-indexed"
-        elif use_sharded:
-            # One code path to N chips: the fused core inside shard_map
-            # over a ("dp",) mesh (parallel/sharding.py).
-            fn = _sharded_fused_fn(n_dev, with_msm=bool(msm_args))
-            ok = fn(
-                jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
-                tail[0][0], tail[0][1], tail[1],
-                tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
-            )[0]
-            self.last_path = "sharded"
-        elif table_args is not None:
-            tx, ty, idx, pinf = table_args
-            fn = _verify_fused_indexed_jit if choice == "1" else _verify_indexed_jit
-            ok = fn(tx, ty, jnp.asarray(idx), jnp.asarray(pinf), *tail,
-                    *msm_args)
-            self.last_path = "indexed"
-        else:
-            fn = _verify_fused_jit if choice == "1" else _verify_jit
-            ok = fn((jnp.asarray(px), jnp.asarray(py)), jnp.asarray(pinf),
-                    *tail, *msm_args)
-            self.last_path = "fused" if choice == "1" else "classic"
+                ok = fn((jnp.asarray(px), jnp.asarray(py)),
+                        jnp.asarray(pinf), *tail, *msm_args)
+                self.last_path = "fused" if choice == "1" else "classic"
+            probe()
         if table_args is None and agg is not None:
             self.last_path += "+host-agg"
+        DISPATCH_BATCHES.inc(path=self.last_path)
         return ok
 
     @staticmethod

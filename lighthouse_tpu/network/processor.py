@@ -32,6 +32,45 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable
 
+from ..common import tracing
+from ..common.metrics import REGISTRY
+
+# Work-scheduler metrics (reference: beacon_processor/mod.rs registers
+# queue-depth / event counters against lighthouse_metrics). The
+# gossip-verify latency a peer experiences is queue wait + handler wall
+# time: the two histograms below, same work_type label.
+QUEUE_LATENCY_SECONDS = REGISTRY.histogram(
+    "beacon_processor_queue_latency_seconds",
+    "Time a work event waited in its queue before dispatch",
+    ("work_type",),
+)
+WORK_SECONDS = REGISTRY.histogram(
+    "beacon_processor_work_seconds",
+    "Handler wall time per dispatched unit (event or coalesced batch)",
+    ("work_type",),
+)
+BATCH_SIZE = REGISTRY.histogram(
+    "beacon_processor_batch_size",
+    "Coalesced verification batch sizes",
+    ("work_type",),
+    buckets=tuple(float(1 << i) for i in range(12)),
+)
+EVENTS_TOTAL = REGISTRY.counter(
+    "beacon_processor_events_total",
+    "Work events processed",
+    ("work_type",),
+)
+DROPPED_TOTAL = REGISTRY.counter(
+    "beacon_processor_dropped_total",
+    "Work events dropped by full queues",
+    ("work_type",),
+)
+QUEUE_DEPTH = REGISTRY.gauge(
+    "beacon_processor_queue_depth",
+    "Current queued events",
+    ("work_type",),
+)
+
 
 class WorkType(str, Enum):
     # gossip (priority order is DRAIN_ORDER below, not enum order)
@@ -71,6 +110,7 @@ class WorkEvent:
 class _Queue:
     maxlen: int
     lifo: bool
+    kind: str = ""  # work_type label for the metric families above
     items: deque = field(default_factory=deque)
     times: deque = field(default_factory=deque)  # arrival order, parallel
     dropped: int = 0
@@ -82,21 +122,30 @@ class _Queue:
                 self.items.popleft()
                 self.times.popleft()
                 self.dropped += 1
+                DROPPED_TOTAL.inc(work_type=self.kind)
             else:
                 self.dropped += 1
+                DROPPED_TOTAL.inc(work_type=self.kind)
                 return False
         self.items.append(event)
         self.times.append(time.monotonic())
+        QUEUE_DEPTH.set(len(self.items), work_type=self.kind)
         return True
 
     def pop(self) -> WorkEvent | None:
         if not self.items:
             return None
         if self.lifo:
-            self.times.pop()
-            return self.items.pop()
-        self.times.popleft()
-        return self.items.popleft()
+            t = self.times.pop()
+            ev = self.items.pop()
+        else:
+            t = self.times.popleft()
+            ev = self.items.popleft()
+        QUEUE_LATENCY_SECONDS.observe(
+            time.monotonic() - t, work_type=self.kind
+        )
+        QUEUE_DEPTH.set(len(self.items), work_type=self.kind)
+        return ev
 
     def overdue(self, deadline_ms: float) -> bool:
         """Has the OLDEST queued entry waited past the deadline?"""
@@ -175,7 +224,8 @@ class BeaconProcessor:
         # node tick does); there is no internal timer.
         self.batch_deadline_ms = batch_deadline_ms
         self.queues: dict[WorkType, _Queue] = {
-            wt: _Queue(maxlen=m, lifo=lifo) for wt, (m, lifo) in QUEUE_SPECS.items()
+            wt: _Queue(maxlen=m, lifo=lifo, kind=wt.value)
+            for wt, (m, lifo) in QUEUE_SPECS.items()
         }
         # handlers: work_type -> fn(list[WorkEvent]) for batched types,
         # fn(WorkEvent) otherwise. Registered by the Router.
@@ -217,15 +267,31 @@ class BeaconProcessor:
                 ):
                     continue  # keep accumulating toward a full batch
                 batch = q.drain(self.attestation_batch_size)
+                BATCH_SIZE.observe(len(batch), work_type=wt.value)
                 if handler is not None:
-                    handler(batch)
+                    # Gossip verify latency for the whole coalesced batch
+                    # (the TPU round trip lives inside this span).
+                    with tracing.span(
+                        "processor/" + wt.value,
+                        metric=WORK_SECONDS,
+                        labels={"work_type": wt.value},
+                        batch=len(batch),
+                    ):
+                        handler(batch)
                 self.batches_dispatched += 1
                 self.events_processed += len(batch)
+                EVENTS_TOTAL.inc(len(batch), work_type=wt.value)
                 return len(batch)
             ev = q.pop()
             if handler is not None:
-                handler(ev)
+                with tracing.span(
+                    "processor/" + wt.value,
+                    metric=WORK_SECONDS,
+                    labels={"work_type": wt.value},
+                ):
+                    handler(ev)
             self.events_processed += 1
+            EVENTS_TOTAL.inc(work_type=wt.value)
             return 1
         return 0
 

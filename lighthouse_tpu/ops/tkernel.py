@@ -366,8 +366,16 @@ def _carry_norm_ks(t, bound: int):
     inputs get +341 on every digit (341 mod 256 != 0, so the corruption
     survives the byte masks), which no oracle-comparison test can miss
     (a silent near-miss is the failure mode this guards against).
+    LHTPU_KS_CHECK is read at TRACE time inside jit-cached callers:
+    set it before the first trace (or jax.clear_caches() after
+    flipping it), otherwise already-traced kernels silently keep the
+    old setting — same cache-key hazard as LHTPU_KS_CARRY.
     """
     rows = t.shape[-2]
+    # The two-carry regroup branch reads c2[..., top - 1, :]; with a
+    # single limb row that -1 would silently resurrect the
+    # negative-index/dynamic_slice Mosaic hazard forbidden above.
+    assert rows >= 2, f"_carry_norm_ks needs >= 2 limb rows, got {rows}"
     top = rows - 1
     if _os.environ.get("LHTPU_KS_CHECK") == "1":
         bad = jnp.any((t < 0) | (t > bound))

@@ -64,6 +64,65 @@ def test_backend_dispatch():
     assert verify_signature_sets(sets, backend="fake")
 
 
+def test_dispatch_stage_instrumentation():
+    """One verify advances the stage histograms/counters and leaves a
+    per-stage breakdown on the backend (the observability contract
+    bench.py and the /metrics scrape depend on)."""
+    from lighthouse_tpu import jax_backend as jb
+
+    be = get_backend("jax")
+    batches_before = sum(v for _, v in jb.DISPATCH_BATCHES.items())
+    assert be.verify_signature_sets(_valid_sets())
+
+    stages = be.last_stage_seconds
+    for stage in ("pack", "hash_to_curve", "scalars", "msm_schedule",
+                  "dispatch", "device_sync"):
+        assert stage in stages and stages[stage] >= 0.0, stages
+    assert sum(v for _, v in jb.DISPATCH_BATCHES.items()) == batches_before + 1
+
+    report = jb.dispatch_stage_report()
+    assert set(report["stages_ms"]) == set(stages)
+    # the dispatch program was jit-dispatched at least once this session
+    assert sum(report["jit_cache"].values()) >= 1
+
+
+def test_dispatch_error_attributed_to_stage(monkeypatch):
+    """A failure inside a dispatch stage increments
+    bls_dispatch_errors_total{stage=...} and is named by
+    dispatch_stage_report() instead of being swallowed (the r05
+    regression class: an opaque crash with zero stage attribution)."""
+    from lighthouse_tpu import jax_backend as jb
+
+    be = jb.JaxBackend()
+
+    def boom(sets, S, inf2):
+        raise RuntimeError("synthetic hash_to_curve failure")
+
+    monkeypatch.setattr(be, "_hash_messages", boom)
+    before = jb.DISPATCH_ERRORS.value(stage="hash_to_curve")
+    with pytest.raises(RuntimeError, match="synthetic"):
+        be.verify_signature_sets(_valid_sets())
+    assert jb.DISPATCH_ERRORS.value(stage="hash_to_curve") == before + 1
+    assert jb.dispatch_stage_report()["failed_stage"] == "hash_to_curve"
+    # stages that completed before the failure are still attributed
+    assert "pack" in be.last_stage_seconds
+
+
+def test_dispatch_stages_empty_when_tracing_disabled():
+    """LHTPU_TRACE=0 contract: spans are no-ops and the per-stage dict
+    stays empty — nothing rides the measured path."""
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu import jax_backend as jb
+
+    be = jb.JaxBackend()
+    prev = tracing.set_enabled(False)
+    try:
+        assert be.verify_signature_sets(_valid_sets())
+    finally:
+        tracing.set_enabled(prev)
+    assert be.last_stage_seconds == {}
+
+
 def test_aggregate_verify_device_matches_oracle():
     """Device AggregateVerify (BASELINE config #1 path) vs the host
     oracle, incl. a tampered-message rejection."""

@@ -15,6 +15,8 @@ production MXU-fold configuration.
 """
 
 import jax
+import jax.export  # noqa: F401 — jax.export is lazy; attribute access
+                   # alone raises AttributeError on this jax version
 import jax.numpy as jnp
 import pytest
 
@@ -25,6 +27,12 @@ from lighthouse_tpu.ops.points import G1_GEN_DEV
 
 @pytest.mark.parametrize("ks", ["0", "1"])
 def test_scalar_mul_g1_lowers_for_tpu(monkeypatch, ks):
+    # LHTPU_KS_CARRY is read at TRACE time and is not part of the jit
+    # cache key: without clearing, the second ks value would silently
+    # reuse the first value's cached jaxpr and the parametrization would
+    # be vacuous (ADVICE r5 — verified: the pre-fix kernel passed ks=1
+    # in-process but failed Mosaic lowering in a fresh one).
+    jax.clear_caches()
     monkeypatch.setenv("LHTPU_KS_CARRY", ks)
     # Production TPU traces run with the MXU fold on; lower that
     # program, not the CPU conv fallback.

@@ -1,0 +1,175 @@
+"""Tracing-layer tests: span nesting/export round-trip, histogram
+mirroring, the LHTPU_TRACE=0 no-op contract, and Prometheus exposition
+of the new dispatch-stage metric families through api/http_metrics."""
+
+import json
+import threading
+import urllib.request
+
+from lighthouse_tpu.api.http_metrics import MetricsServer
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.common.metrics import REGISTRY, Registry
+
+
+class TestSpans:
+    def test_nesting_and_export_round_trip(self):
+        tracer = tracing.Tracer()
+        with tracer.span("root", kind="test") as root:
+            with tracer.span("child_a"):
+                with tracer.span("grandchild"):
+                    pass
+            with tracer.span("child_b") as b:
+                b.set(lanes=4)
+        assert tracer.current() is None
+        roots = tracer.roots()
+        assert [r.name for r in roots] == ["root"]
+        assert [c.name for c in roots[0].children] == ["child_a", "child_b"]
+        assert roots[0].children[0].children[0].name == "grandchild"
+        assert roots[0].duration >= sum(
+            c.duration for c in roots[0].children
+        )
+        # JSON export round-trips the structure and attrs
+        parsed = json.loads(tracer.to_json())
+        assert parsed[0]["name"] == "root"
+        assert parsed[0]["attrs"] == {"kind": "test"}
+        kids = parsed[0]["children"]
+        assert [k["name"] for k in kids] == ["child_a", "child_b"]
+        assert kids[1]["attrs"] == {"lanes": 4}
+
+    def test_chrome_trace_events(self):
+        tracer = tracing.Tracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        events = tracer.chrome_trace()
+        assert [e["name"] for e in events] == ["outer", "inner"]
+        for e in events:
+            assert e["ph"] == "X"
+            assert e["dur"] >= 0.0
+            assert {"ts", "pid", "tid", "args"} <= set(e)
+        # a Chrome trace file is just JSON of these events
+        json.dumps({"traceEvents": events})
+
+    def test_exception_recorded_and_reraised(self):
+        tracer = tracing.Tracer()
+        try:
+            with tracer.span("doomed"):
+                raise RuntimeError("kaput")
+        except RuntimeError:
+            pass
+        (root,) = tracer.roots()
+        assert root.attrs["error"] == "RuntimeError"
+        assert root.duration is not None
+
+    def test_ring_buffer_bounded(self):
+        tracer = tracing.Tracer(max_roots=4)
+        for i in range(10):
+            with tracer.span(f"s{i}"):
+                pass
+        assert [r.name for r in tracer.roots()] == [
+            "s6", "s7", "s8", "s9"
+        ]
+
+    def test_thread_isolation(self):
+        tracer = tracing.Tracer()
+        seen = {}
+
+        def worker():
+            with tracer.span("worker_root"):
+                seen["inner"] = tracer.current().name
+
+        with tracer.span("main_root"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            # the worker's span must NOT have nested under main_root
+            assert tracer.current().name == "main_root"
+        assert seen["inner"] == "worker_root"
+        names = {r.name for r in tracer.roots()}
+        assert names == {"main_root", "worker_root"}
+
+    def test_histogram_mirroring(self):
+        reg = Registry()
+        h = reg.histogram("stage_seconds", "S", ("stage",))
+        tracer = tracing.Tracer()
+        with tracer.span("op/pack", metric=h, labels={"stage": "pack"}):
+            pass
+        text = reg.gather()
+        assert 'stage_seconds_count{stage="pack"} 1' in text
+        # the shared by-name family in the GLOBAL registry also advanced
+        before = tracing.SPAN_SECONDS
+        assert 'lhtpu_span_seconds' in REGISTRY.gather()
+        assert before is REGISTRY.histogram(
+            "lhtpu_span_seconds", "", ("span",)
+        )
+
+    def test_disabled_is_noop(self):
+        prev = tracing.set_enabled(False)
+        try:
+            tracer = tracing.Tracer()
+            sp = tracer.span("invisible", metric=None, attr=1)
+            assert sp is tracing.NULL_SPAN
+            with sp:
+                sp.set(anything="goes")
+            assert tracer.roots() == []
+            assert tracer.chrome_trace() == []
+        finally:
+            tracing.set_enabled(prev)
+
+    def test_module_level_convenience(self):
+        tracing.clear()
+        with tracing.span("module_root"):
+            pass
+        assert any(r.name == "module_root" for r in tracing.roots())
+        tracing.clear()
+        assert tracing.roots() == []
+
+
+class TestExposition:
+    def test_dispatch_families_scrapable(self):
+        # Importing the backend registers the dispatch metric families
+        # on the global registry; the scrape must carry them in valid
+        # text exposition even before any batch ran.
+        import lighthouse_tpu.jax_backend  # noqa: F401
+
+        srv = MetricsServer().start()
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics") as resp:
+                text = resp.read().decode()
+            for family, typ in (
+                ("bls_dispatch_stage_seconds", "histogram"),
+                ("bls_dispatch_batch_sets", "histogram"),
+                ("bls_dispatch_batch_keys", "histogram"),
+                ("bls_dispatch_errors_total", "counter"),
+                ("bls_dispatch_batches_total", "counter"),
+                ("bls_jit_cache_events_total", "counter"),
+                ("bls_signature_sets_built_total", "counter"),
+                ("lhtpu_span_seconds", "histogram"),
+            ):
+                assert f"# TYPE {family} {typ}" in text, family
+            with urllib.request.urlopen(srv.url + "/trace") as resp:
+                trace = json.loads(resp.read().decode())
+            assert "traceEvents" in trace
+        finally:
+            srv.stop()
+
+
+class TestSlotClockMetrics:
+    def test_gauges_and_lateness(self):
+        from lighthouse_tpu.common.slot_clock import (
+            SLOT_GAUGE,
+            SLOT_LATENESS_SECONDS,
+            ManualSlotClock,
+        )
+
+        clock = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+        clock.set_slot(5)
+        clock.advance_time(3.0)
+        assert clock.now() == 5
+        assert SLOT_GAUGE.value() == 5
+        late = clock.record_lateness("block_import", 5)
+        assert abs(late - 3.0) < 1e-6
+        assert (
+            'slot_clock_lateness_seconds_count{event="block_import"}'
+            in REGISTRY.gather()
+        )

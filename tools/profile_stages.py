@@ -5,16 +5,33 @@ hash-to-G2 pipeline separately (block_until_ready around each), plus the
 host-side assembly costs, at the bench shape S=2048, K=1. Guides kernel
 optimization: run after kernel changes to see which stage moved.
 
-Usage:  python tools/profile_stages.py [S]
+With ``--json`` the human-readable lines go to stderr and stdout gets
+ONE parseable JSON line — {"metric": "bls_stage_profile", "stages_ms":
+{...}} — the same per-stage breakdown shape bench.py embeds, so a
+round's BENCH json can carry a device-stage profile.
+
+Usage:  python tools/profile_stages.py [S] [--json]
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
 
 import numpy as np
+
+JSON_MODE = "--json" in sys.argv
+
+#: label -> milliseconds, accumulated by record()/timeit for --json
+STAGES_MS: dict[str, float] = {}
+
+
+def record(label: str, ms: float) -> None:
+    STAGES_MS[label] = round(ms, 3)
+    print(f"{label:42s} {ms:10.1f} ms",
+          file=sys.stderr if JSON_MODE else sys.stdout)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "")
@@ -49,14 +66,16 @@ def timeit(label, fn, reps=3):
         out = fn()
         jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps * 1e3
-    print(f"{label:42s} {dt:10.1f} ms")
+    record(label, dt)
     return dt
 
 
 def main():
-    S = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    S = int(args[0]) if args else 2048
     K = 1
-    print(f"device={jax.devices()[0].platform} S={S} K={K}")
+    print(f"device={jax.devices()[0].platform} S={S} K={K}",
+          file=sys.stderr if JSON_MODE else sys.stdout)
 
     sks = [SecretKey.from_int(i + 101) for i in range(S)]
     msgs = [i.to_bytes(32, "big") for i in range(S)]
@@ -68,18 +87,18 @@ def main():
     # ------------------------------------------------ host assembly costs
     t0 = time.perf_counter()
     px, py, pinf = g1_to_dev([s.signing_keys[0].point for s in sets])
-    print(f"{'host g1_to_dev (pubkeys)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    record('host g1_to_dev (pubkeys)', (time.perf_counter()-t0)*1e3)
     px, py, pinf = px.reshape(S, K, 48), py.reshape(S, K, 48), pinf.reshape(S, K)
     t0 = time.perf_counter()
     sx, sy, sinf = g2_to_dev([s.signature.point for s in sets])
-    print(f"{'host g2_to_dev (sigs)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    record('host g2_to_dev (sigs)', (time.perf_counter()-t0)*1e3)
     t0 = time.perf_counter()
     mpts = [hash_to_g2(m) for m in msgs]
-    print(f"{'host hash_to_g2 python x S':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    record('host hash_to_g2 python x S', (time.perf_counter()-t0)*1e3)
     mx, my, minf = g2_to_dev(mpts)
     t0 = time.perf_counter()
     r_bits = _rand_bits_array(S)
-    print(f"{'host rand bits':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    record('host rand bits', (time.perf_counter()-t0)*1e3)
 
     pk = (jnp.asarray(px), jnp.asarray(py))
     pinf_d = jnp.asarray(pinf)
@@ -158,7 +177,7 @@ def main():
     t0 = time.perf_counter()
     u = jnp.asarray(hash_to_field_dev(msgs, DST))
     u = jax.block_until_ready(u)
-    print(f"{'host hash_to_field (SHA)':42s} {(time.perf_counter()-t0)*1e3:10.1f} ms")
+    record('host hash_to_field (SHA)', (time.perf_counter()-t0)*1e3)
 
     n = u.shape[0]
     flat = jnp.moveaxis(u, 1, 0).reshape(2 * n, 2, 48)
@@ -174,6 +193,14 @@ def main():
     Qc = jax.block_until_ready(_cofactor_t(Q, _interpret()))
     timeit("to_affine_g2 (hash out)", lambda: tc.to_affine_g2_t(Qc))
     timeit("hash full _map_to_g2_fused", lambda: _map_to_g2_fused(u))
+
+    if JSON_MODE:
+        print(json.dumps({
+            "metric": "bls_stage_profile",
+            "stages_ms": STAGES_MS,
+            "detail": {"S": S, "K": K,
+                       "device": jax.devices()[0].platform},
+        }), flush=True)
 
 
 if __name__ == "__main__":
