@@ -1,6 +1,6 @@
 """Cross-cutting commons (reference: common/*)."""
 
-from . import tracing  # noqa: F401
+from . import resilience, tracing  # noqa: F401
 from .logging import NullLogger, StructuredLogger, test_logger  # noqa: F401
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry  # noqa: F401
 from .slot_clock import ManualSlotClock, SlotClock, SystemSlotClock  # noqa: F401

@@ -43,7 +43,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .common import tracing
+from .common import resilience, tracing
+from .common.logging import StructuredLogger
 from .common.metrics import REGISTRY
 from .crypto.bls.backends import register_backend
 from .crypto.bls.constants import RAND_BITS
@@ -108,12 +109,19 @@ JIT_CACHE_EVENTS = REGISTRY.counter(
     "Verify-program jit dispatches by compile-cache outcome",
     ("fn", "event"),
 )
+NATIVE_LOAD_FAILURES = REGISTRY.counter(
+    "native_backend_load_failures_total",
+    "Native C++ BLS backend load attempts that found no usable library",
+)
 
-# Most recent dispatch's stage timings / failure, for bench attribution
-# (bench.py reads these through dispatch_stage_report even when the
-# dispatch died mid-flight).
+_LOG = StructuredLogger("jax_backend")
+
+# Most recent dispatch's stage timings / failure / path, for bench
+# attribution (bench.py reads these through dispatch_stage_report even
+# when the dispatch died mid-flight).
 _LAST_STAGES: dict[str, float] = {}
 _LAST_ERROR_STAGE: str | None = None
+_LAST_PATH: str | None = None
 
 
 @contextmanager
@@ -143,6 +151,23 @@ def _stage(name: str, stages: dict):
         DISPATCH_ERRORS.inc(stage=name)
         raise
     stages[name] = time.perf_counter() - t0
+
+
+def _retry_stage(name: str, stages: dict, fn):
+    """Run ONE dispatch stage with fault injection + bounded transient
+    retry: the retry re-enters at this stage, not the whole pipeline
+    (the r05 remote_compile drop inside hash_to_curve re-runs only the
+    hash). Each failed attempt still lands in
+    bls_dispatch_errors_total{stage=...} (attribution is per-attempt);
+    each retry lands in bls_dispatch_retries_total{stage,kind}.
+    Permanent faults and exhausted budgets re-raise to the ladder."""
+
+    def attempt():
+        with _stage(name, stages):
+            resilience.maybe_inject(name)
+            return fn()
+
+    return resilience.call_with_retries(attempt, stage=name)
 
 
 def _jit_cache_probe(fn, label: str):
@@ -184,18 +209,51 @@ def dispatch_stage_report() -> dict:
             f"{lbl['fn']}:{lbl['event']}": v
             for lbl, v in JIT_CACHE_EVENTS.items()
         },
+        "retries": {
+            f"{lbl['stage']}:{lbl['kind']}": v
+            for lbl, v in resilience.RETRIES_TOTAL.items()
+        },
+        "degraded": {
+            lbl["path"]: v for lbl, v in resilience.DEGRADED_TOTAL.items()
+        },
+        "breaker": resilience.breaker_states(),
+        "path": _LAST_PATH,
     }
+
+
+_NATIVE_LOAD_WARNED: set[str] = set()
 
 
 def _try_load_native():
     """The native C++ BLS backend, or None when the library can't load
-    (no compiler / build failure) — callers fall back to device paths."""
+    (no compiler / build failure) — callers fall back to device paths.
+
+    A degraded run must be able to say WHY native was unavailable: the
+    cause is logged once per distinct message at WARNING and counted in
+    native_backend_load_failures_total (previously every exception was
+    swallowed silently)."""
+    cause = None
     try:
         from .crypto.bls.native_backend import load_native_backend
 
-        return load_native_backend()
-    except Exception:
-        return None
+        backend = load_native_backend()
+    except Exception as exc:
+        backend = None
+        cause = f"{type(exc).__name__}: {exc}"
+    if backend is not None:
+        return backend
+    if cause is None:
+        from .native import bls_load_error
+
+        cause = bls_load_error() or "unknown (toolchain unavailable?)"
+    if cause not in _NATIVE_LOAD_WARNED:
+        _NATIVE_LOAD_WARNED.add(cause)
+        NATIVE_LOAD_FAILURES.inc()
+        _LOG.warn(
+            "Native BLS backend unavailable",
+            cause=cause.replace("\n", " ")[:300],
+        )
+    return None
 
 
 def _fused_choice() -> str:
@@ -724,13 +782,22 @@ class JaxBackend:
         return g2_to_dev(msgs)
 
     def verify_signature_sets(self, sets) -> bool:
-        out = self._dispatch(sets)
-        if isinstance(out, bool):
-            return out
-        # Forcing the device scalar is where async dispatch errors and
-        # device wall time surface — its own attributed stage.
-        with _stage("device_sync", self.last_stage_seconds):
-            return bool(out)
+        """Resilient entry point: transient faults inside any dispatch
+        stage are retried at that stage; a rung that keeps failing (or
+        fails permanently) trips its circuit breaker and the call
+        degrades down the ladder fused → classic → native, so one PJRT
+        tunnel hiccup no longer turns a verdict into a crash (the
+        r03/r05 bench-zeroing class). LHTPU_RESILIENCE=0 restores the
+        raw raise-through behavior."""
+        if not resilience.enabled():
+            out = self._dispatch(sets)
+            if isinstance(out, bool):
+                return out
+            # Forcing the device scalar is where async dispatch errors
+            # and device wall time surface — its own attributed stage.
+            with _stage("device_sync", self.last_stage_seconds):
+                return bool(out)
+        return self._verify_resilient(sets)
 
     def verify_signature_sets_async(self, sets):
         """Dispatch the batch and return a zero-arg resolver.
@@ -743,19 +810,154 @@ class JaxBackend:
 
             pending = [backend.verify_signature_sets_async(b) for b in batches]
             verdicts = [resolve() for resolve in pending]
+
+        Resilience: a failure at dispatch or at the force falls back to
+        the synchronous resilient ladder (the verdict is late, never
+        lost); the force itself runs under the device_sync deadline.
         """
-        out = self._dispatch(sets)
+        if not resilience.enabled():
+            out = self._dispatch(sets)
+            if isinstance(out, bool):
+                return lambda: out
+            stages = self.last_stage_seconds
+
+            def resolve_raw() -> bool:
+                with _stage("device_sync", stages):
+                    return bool(out)
+
+            return resolve_raw
+
+        try:
+            out = self._dispatch(sets)
+        except Exception as exc:
+            self._record_rung_failure(exc)
+            return lambda: self._verify_resilient(sets)
         if isinstance(out, bool):
             return lambda: out
         stages = self.last_stage_seconds
+        rung = self._last_rung
 
         def resolve() -> bool:
-            with _stage("device_sync", stages):
-                return bool(out)
+            try:
+                with _stage("device_sync", stages):
+                    return bool(
+                        resilience.force_with_deadline(lambda: bool(out))
+                    )
+            except Exception as exc:
+                self._record_rung_failure(exc, rung)
+                return self._verify_resilient(sets)
 
         return resolve
 
-    def _dispatch(self, sets):
+    # ------------------------------------------------ resilience ladder
+    # Which rung the last _dispatch ran on ("fused" | "classic" |
+    # "native") — breaker bookkeeping for the async resolver.
+    _last_rung: str | None = None
+
+    def _ladder(self) -> list[str]:
+        """The degradation ladder from the configured primary path down
+        (all rungs return bit-identical verdicts; tests pin this)."""
+        first = "fused" if _fused_choice() == "1" else "classic"
+        rungs = list(resilience.LADDER)
+        return rungs[rungs.index(first):]
+
+    def _verify_resilient(self, sets) -> bool:
+        """Walk the ladder: first rung whose breaker admits the call
+        and whose dispatch survives (with per-stage transient retries)
+        answers. Failures feed the rung's breaker — permanent ones trip
+        it straight to open; the bottom rung is always attempted."""
+        ladder = self._ladder()
+        last_exc: Exception | None = None
+        for i, rung in enumerate(ladder):
+            br = resilience.breaker(rung)
+            if not br.allow() and i < len(ladder) - 1:
+                continue  # open breaker: degrade without attempting
+            try:
+                verdict = self._verify_once(
+                    sets, path_override=None if i == 0 else rung
+                )
+            except Exception as exc:
+                category, _ = resilience.classify(exc)
+                br.record_failure(
+                    permanent=category == resilience.PERMANENT
+                )
+                last_exc = exc
+                continue
+            br.record_success()
+            if i > 0:
+                resilience.DEGRADED_TOTAL.inc(path=rung)
+                _LOG.warn(
+                    "BLS dispatch degraded",
+                    rung=rung,
+                    path=self.last_path,
+                    cause=str(last_exc)[:200] if last_exc
+                    else "breaker open",
+                )
+            return verdict
+        raise last_exc
+
+    def _verify_once(self, sets, path_override=None) -> bool:
+        """One rung's dispatch + device_sync force. A transient failure
+        at the force is retried by RE-DISPATCHING the batch (the failed
+        async buffer is poisoned; only a fresh dispatch can recover),
+        under the same bounded policy as the per-stage retries. The
+        force runs under the LHTPU_SYNC_DEADLINE_S deadline so a wedged
+        transfer becomes a classified transient, not a hang."""
+        policy = resilience.retry_policy()
+        attempt = 0
+        while True:
+            out = self._dispatch(sets, path_override=path_override)
+            if isinstance(out, bool):
+                return out
+            try:
+                with _stage("device_sync", self.last_stage_seconds):
+                    return bool(
+                        resilience.force_with_deadline(lambda: bool(out))
+                    )
+            except Exception as exc:
+                category, kind = resilience.classify(exc)
+                if (not resilience.enabled()
+                        or category != resilience.TRANSIENT
+                        or attempt >= policy.max_retries):
+                    raise
+                attempt += 1
+                resilience.RETRIES_TOTAL.inc(stage="device_sync", kind=kind)
+                policy.sleep(attempt)
+
+    def _record_rung_failure(self, exc, rung: str | None = None) -> None:
+        category, _ = resilience.classify(exc)
+        rung = rung or self._last_rung or self._ladder()[0]
+        resilience.breaker(rung).record_failure(
+            permanent=category == resilience.PERMANENT
+        )
+
+    def _host_rung_verify(self, sets, stages) -> bool:
+        """The bottom rung: native C++ when loadable, else the pure-
+        Python oracle — the last resort must always exist, and a slow
+        verdict beats a zeroed bench (reference: SURVEY §7.3 "keep a
+        host CPU fallback path")."""
+        nb = _try_load_native()
+
+        def run() -> bool:
+            if nb is not None:
+                self.last_path = "native-fallback"
+                return bool(nb.verify_signature_sets(sets))
+            from .crypto.bls.api import verify_signature_sets_python
+
+            self.last_path = "python-fallback"
+            _LOG.warn(
+                "native BLS unavailable on degraded dispatch; using the "
+                "pure-Python oracle", sets=len(sets),
+            )
+            return bool(verify_signature_sets_python(sets))
+
+        verdict = _retry_stage("native_fallback", stages, run)
+        global _LAST_PATH
+        _LAST_PATH = self.last_path
+        DISPATCH_BATCHES.inc(path=self.last_path)
+        return verdict
+
+    def _dispatch(self, sets, path_override: str | None = None):
         """Common assembly + device dispatch; returns a host bool (for
         structural rejections) or the un-forced device verdict scalar.
 
@@ -764,11 +966,20 @@ class JaxBackend:
         device_sync at the force point): wall time lands in
         bls_dispatch_stage_seconds, a failure increments
         bls_dispatch_errors_total{stage=...} and is named in
-        dispatch_stage_report() instead of being swallowed."""
-        global _LAST_STAGES
+        dispatch_stage_report() instead of being swallowed. Each stage
+        additionally runs under _retry_stage (transient-fault retry
+        re-entering at the failing stage + LHTPU_FAULT_INJECT hook).
+
+        ``path_override`` pins one ladder rung ("fused" | "classic" |
+        "native") for degraded dispatches: overridden calls skip the
+        opportunistic host-fallback routing and (for "classic") the
+        mesh sharding, so a rung behaves deterministically under its
+        breaker."""
+        global _LAST_STAGES, _LAST_PATH
         stages: dict[str, float] = {}
         _LAST_STAGES = stages
         self.last_stage_seconds = stages
+        self._last_rung = None
         if not sets:
             return False
         # Host-side structural rejections (reference: impls/blst.rs:79-88).
@@ -785,6 +996,10 @@ class JaxBackend:
         DISPATCH_BATCH_SETS.observe(n)
         DISPATCH_BATCH_KEYS.observe(total_keys)
 
+        if path_override == "native":
+            self._last_rung = "native"
+            return self._host_rung_verify(sets, stages)
+
         # Small-batch host fallback (SURVEY §7.3: "keep a host CPU
         # fallback path for singletons"): device dispatch latency
         # (~110 ms measured through this TPU's tunnel) dwarfs tiny
@@ -795,7 +1010,8 @@ class JaxBackend:
         # is LHTPU_HOST_FALLBACK_MS. TPU-only so CPU tests keep
         # exercising the device paths.
         if (
-            os.environ.get("LHTPU_HOST_FALLBACK", "1") == "1"
+            path_override is None
+            and os.environ.get("LHTPU_HOST_FALLBACK", "1") == "1"
             and jax.default_backend() == "tpu"
         ):
             est_native_ms = 3.3 * n + 0.05 * total_keys
@@ -805,15 +1021,22 @@ class JaxBackend:
                 nb = _try_load_native()
                 if nb is not None:
                     self.last_path = "native-fallback"
+                    self._last_rung = "native"
+                    _LAST_PATH = "native-fallback"
                     DISPATCH_BATCHES.inc(path="native-fallback")
-                    with _stage("native_fallback", stages):
-                        return bool(nb.verify_signature_sets(sets))
+                    return _retry_stage(
+                        "native_fallback", stages,
+                        lambda: bool(nb.verify_signature_sets(sets)),
+                    )
 
         S = _next_pow2(n)
         K = _next_pow2(max(len(s.signing_keys) for s in sets))
 
         # Path choice up front (it shapes the padding).
-        choice = _fused_choice()
+        choice = {"fused": "1", "classic": "0"}.get(
+            path_override, _fused_choice()
+        )
+        self._last_rung = "fused" if choice == "1" else "classic"
         n_dev = len(jax.devices())
         shard = os.environ.get("LHTPU_SHARDED_VERIFY")
         use_sharded = choice == "1" and (
@@ -830,7 +1053,7 @@ class JaxBackend:
 
         inf1, inf2 = g1_infinity(), g2_infinity()
 
-        with _stage("pack", stages):
+        def run_pack():
             # HBM-table fast path: every set carries validator indices the
             # device table covers -> gather on device, no coordinate
             # upload. Composes with sharding (the table is replicated per
@@ -838,6 +1061,7 @@ class JaxBackend:
             table_args = self._table_gather_args(sets, S, K)
 
             agg = None  # host-aggregated rows; only on the non-table path
+            px = py = pinf = None
             if table_args is None:
                 # Host pubkey aggregation pays n*mean_K serial CPU point
                 # adds to collapse the grid to K=1; worth it only when the
@@ -883,17 +1107,25 @@ class JaxBackend:
 
             sigs = [s.signature.point for s in sets] + [inf2] * (S - n)
             sx, sy, sinf = g2_to_dev(sigs)
+            return table_args, agg, px, py, pinf, sx, sy, sinf
 
-        with _stage("hash_to_curve", stages):
-            mx, my, minf = self._hash_messages(sets, S, inf2)
+        table_args, agg, px, py, pinf, sx, sy, sinf = _retry_stage(
+            "pack", stages, run_pack
+        )
 
-        with _stage("scalars", stages):
-            r_u64, r_bits = _rand_scalars(S)
+        mx, my, minf = _retry_stage(
+            "hash_to_curve", stages,
+            lambda: self._hash_messages(sets, S, inf2),
+        )
+
+        r_u64, r_bits = _retry_stage(
+            "scalars", stages, lambda: _rand_scalars(S)
+        )
 
         # Bucketed-MSM schedule for the RLC signature accumulator
         # (host-side — the scalars are host CSPRNG output; ops/msm.py).
         # None -> the cores keep their per-lane scalar-mul scan.
-        with _stage("msm_schedule", stages):
+        def run_msm_schedule():
             msm_sched = None
             if choice == "1" and os.environ.get("LHTPU_MSM_VERIFY", "1") == "1":
                 from .ops import msm as _msm
@@ -908,10 +1140,13 @@ class JaxBackend:
                     msm_sched = _msm.build_schedule(
                         r_u64, _msm.max_rounds(S), skip
                     )
+            return msm_sched
+
+        msm_sched = _retry_stage("msm_schedule", stages, run_msm_schedule)
 
         # Transfer + async enqueue (a jit-cache miss makes this stage the
         # trace+compile — bls_jit_cache_events_total disambiguates).
-        with _stage("dispatch", stages):
+        def run_device_dispatch():
             msm_args = (
                 ()
                 if msm_sched is None
@@ -927,12 +1162,12 @@ class JaxBackend:
             if use_sharded and table_args is not None:
                 # All three fast paths composed: HBM-table gather +
                 # shard_map over a ("dp",) mesh + fused kernels.
-                tx, ty, idx, pinf = table_args
+                tx, ty, idx, tinf = table_args
                 fn = _sharded_fused_fn(n_dev, indexed=True,
                                        with_msm=bool(msm_args))
                 probe = _jit_cache_probe(fn, "sharded-indexed")
                 ok = fn(
-                    tx, ty, jnp.asarray(idx), jnp.asarray(pinf),
+                    tx, ty, jnp.asarray(idx), jnp.asarray(tinf),
                     tail[0][0], tail[0][1], tail[1],
                     tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
                 )[0]
@@ -949,11 +1184,11 @@ class JaxBackend:
                 )[0]
                 self.last_path = "sharded"
             elif table_args is not None:
-                tx, ty, idx, pinf = table_args
+                tx, ty, idx, tinf = table_args
                 fn = (_verify_fused_indexed_jit if choice == "1"
                       else _verify_indexed_jit)
                 probe = _jit_cache_probe(fn, "indexed")
-                ok = fn(tx, ty, jnp.asarray(idx), jnp.asarray(pinf), *tail,
+                ok = fn(tx, ty, jnp.asarray(idx), jnp.asarray(tinf), *tail,
                         *msm_args)
                 self.last_path = "indexed"
             else:
@@ -965,8 +1200,12 @@ class JaxBackend:
                         jnp.asarray(pinf), *tail, *msm_args)
                 self.last_path = "fused" if choice == "1" else "classic"
             probe()
+            return ok
+
+        ok = _retry_stage("dispatch", stages, run_device_dispatch)
         if table_args is None and agg is not None:
             self.last_path += "+host-agg"
+        _LAST_PATH = self.last_path
         DISPATCH_BATCHES.inc(path=self.last_path)
         return ok
 

@@ -20,6 +20,12 @@ _LOCK = threading.Lock()
 _LIB = None
 _SHA_LIB = None
 
+#: why the last lhbls load attempt failed (None = never failed /
+#: succeeded) — surfaced so callers can attribute a degraded run
+#: instead of swallowing the cause (jax_backend._try_load_native logs it
+#: once and bumps native_backend_load_failures_total).
+_BLS_LOAD_ERROR = None
+
 
 class NativeBuildError(RuntimeError):
     pass
@@ -125,7 +131,7 @@ def load_lhbls():
     """Native CPU BLS12-381 (bls12381.cpp + sha256.cpp): RLC batch verify,
     hash-to-G2, pairing — the measured CPU baseline (SURVEY §2.6 item 1).
     Returns None when the toolchain is unavailable."""
-    global _BLS_LIB
+    global _BLS_LIB, _BLS_LOAD_ERROR
     with _LOCK:
         if _BLS_LIB is None:
             try:
@@ -135,8 +141,9 @@ def load_lhbls():
                         ("-O3", "-pthread"),
                     )
                 )
-            except (NativeBuildError, OSError):
+            except (NativeBuildError, OSError) as exc:
                 _BLS_LIB = False
+                _BLS_LOAD_ERROR = f"{type(exc).__name__}: {exc}"
                 return None
             lib.lhbls_init.restype = ctypes.c_int
             lib.lhbls_init.argtypes = [
@@ -174,9 +181,16 @@ def load_lhbls():
             rc = lib.lhbls_init(blob, len(blob), DST, len(DST))
             if rc != 0:
                 _BLS_LIB = False
+                _BLS_LOAD_ERROR = f"lhbls_init rc={rc}"
                 return None
             _BLS_LIB = lib
     return _BLS_LIB or None
+
+
+def bls_load_error():
+    """The recorded cause of the last failed lhbls load (None when the
+    library loaded or was never attempted)."""
+    return _BLS_LOAD_ERROR
 
 
 def load_lhkv() -> ctypes.CDLL:

@@ -133,6 +133,19 @@ def _drop_jax_executables_between_modules():
     jax.clear_caches()
 
 
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """Breakers tripped / faults injected by one test must not leak
+    into the next (an open 'classic' breaker would silently reroute
+    every later verify through the host rung)."""
+    yield
+    import sys as _sys
+
+    mod = _sys.modules.get("lighthouse_tpu.common.resilience")
+    if mod is not None:
+        mod.reset()
+
+
 @pytest.fixture
 def fake_backend():
     """Run the test under the always-valid fake BLS backend (reference:

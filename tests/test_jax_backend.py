@@ -90,8 +90,13 @@ def test_dispatch_error_attributed_to_stage(monkeypatch):
     """A failure inside a dispatch stage increments
     bls_dispatch_errors_total{stage=...} and is named by
     dispatch_stage_report() instead of being swallowed (the r05
-    regression class: an opaque crash with zero stage attribution)."""
+    regression class: an opaque crash with zero stage attribution).
+    Since the resilience ladder landed, a PERMANENT failure of the
+    device rung additionally trips that rung's breaker and the call
+    degrades to the host rung — the verdict survives, the attribution
+    stays."""
     from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
 
     be = jb.JaxBackend()
 
@@ -100,10 +105,20 @@ def test_dispatch_error_attributed_to_stage(monkeypatch):
 
     monkeypatch.setattr(be, "_hash_messages", boom)
     before = jb.DISPATCH_ERRORS.value(stage="hash_to_curve")
-    with pytest.raises(RuntimeError, match="synthetic"):
-        be.verify_signature_sets(_valid_sets())
+    # the device rung (classic off-TPU) dies permanently; the ladder
+    # answers from the host rung with the correct verdict
+    assert be.verify_signature_sets(_valid_sets())
+    assert be.last_path in ("native-fallback", "python-fallback")
+    assert resilience.breaker("classic").state == resilience.OPEN
     assert jb.DISPATCH_ERRORS.value(stage="hash_to_curve") == before + 1
     assert jb.dispatch_stage_report()["failed_stage"] == "hash_to_curve"
+
+    # with resilience disabled, the raw raise-through contract holds
+    monkeypatch.setenv("LHTPU_RESILIENCE", "0")
+    resilience.reset()
+    with pytest.raises(RuntimeError, match="synthetic"):
+        be.verify_signature_sets(_valid_sets())
+    assert jb.DISPATCH_ERRORS.value(stage="hash_to_curve") == before + 2
     # stages that completed before the failure are still attributed
     assert "pack" in be.last_stage_seconds
 

@@ -145,6 +145,13 @@ class TestExposition:
                 ("bls_jit_cache_events_total", "counter"),
                 ("bls_signature_sets_built_total", "counter"),
                 ("lhtpu_span_seconds", "histogram"),
+                # resilience layer (ISSUE 2): retry / breaker / ladder
+                ("bls_dispatch_retries_total", "counter"),
+                ("bls_breaker_state", "gauge"),
+                ("bls_degraded_dispatches_total", "counter"),
+                ("bls_faults_injected_total", "counter"),
+                ("bls_deadline_exceeded_total", "counter"),
+                ("native_backend_load_failures_total", "counter"),
             ):
                 assert f"# TYPE {family} {typ}" in text, family
             with urllib.request.urlopen(srv.url + "/trace") as resp:

@@ -1,0 +1,173 @@
+"""Fault drill: the bench warm path under a matrix of injected faults.
+
+For every (dispatch stage × fault kind) cell, injects one deterministic
+fault via ``LHTPU_FAULT_INJECT=<stage>:<kind>:1`` and runs a warm
+``verify_signature_sets`` batch through the resilient backend, then
+checks the contract of `common/resilience.py`:
+
+* a *transient* kind (``remote_compile`` — the literal r05 failure)
+  must be absorbed by an in-stage retry: verdict True, >=1 retry
+  recorded, no degradation;
+* a *permanent* kind (``mosaic`` — the literal r04 failure) must trip
+  the rung's circuit breaker and answer from a lower ladder rung:
+  verdict True, >=1 degraded dispatch recorded.
+
+Prints a pass/fail table (or one JSON line with ``--json``) and exits
+nonzero if any cell broke the contract — so every rung of the
+degradation ladder is exercised in CI without a TPU. ``--quick`` runs
+a 3-stage subset (the tier-1 smoke in tests/test_resilience.py calls
+run_drill directly with the same subset).
+
+Usage:  python tools/fault_drill.py [--quick] [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = (
+    "pack", "hash_to_curve", "scalars", "msm_schedule", "dispatch",
+    "device_sync",
+)
+QUICK_STAGES = ("hash_to_curve", "dispatch", "device_sync")
+
+#: kind -> (classifier category, human label)
+KINDS = (
+    ("remote_compile", "transient"),
+    ("mosaic", "permanent"),
+)
+
+
+def _mk_sets():
+    """A tiny valid batch in the same (S=2, K=2) compile bucket the
+    fast test tier already pays for."""
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        SecretKey,
+        SignatureSet,
+    )
+
+    sks = [SecretKey.from_int(i + 7) for i in range(3)]
+    m0, m1 = b"\x11" * 32, b"\x22" * 32
+    s0 = SignatureSet.single_pubkey(sks[0].sign(m0), sks[0].public_key(), m0)
+    agg = AggregateSignature.aggregate([sks[1].sign(m1), sks[2].sign(m1)])
+    s1 = SignatureSet.multiple_pubkeys(
+        agg, [sks[1].public_key(), sks[2].public_key()], m1
+    )
+    return [s0, s1]
+
+
+def _total(counter) -> float:
+    return sum(v for _, v in counter.items())
+
+
+def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None):
+    """Run the injection matrix; returns a list of per-cell dicts with
+    an ``ok`` verdict each. Restores the env and resilience state it
+    touched (safe to call from tests)."""
+    from lighthouse_tpu.common import resilience
+    from lighthouse_tpu.jax_backend import JaxBackend
+
+    if backend is None:
+        backend = JaxBackend()
+    if sets is None:
+        sets = _mk_sets()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"  # no backoff sleeps in a drill
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    results = []
+    try:
+        # Healthy warm pass: pays the one compile and pins the baseline
+        # verdict every drilled cell must reproduce.
+        assert backend.verify_signature_sets(sets), "healthy warm pass failed"
+        healthy_path = backend.last_path
+
+        for stage in stages:
+            for kind, category in kinds:
+                resilience.reset()
+                retries0 = _total(resilience.RETRIES_TOTAL)
+                degraded0 = _total(resilience.DEGRADED_TOTAL)
+                os.environ["LHTPU_FAULT_INJECT"] = f"{stage}:{kind}:1"
+                error = None
+                try:
+                    verdict = backend.verify_signature_sets(sets)
+                except Exception as exc:  # contract breach, not a crash
+                    verdict = None
+                    error = f"{type(exc).__name__}: {exc}"
+                finally:
+                    os.environ.pop("LHTPU_FAULT_INJECT", None)
+                retries = _total(resilience.RETRIES_TOTAL) - retries0
+                degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+                if category == "transient":
+                    ok = verdict is True and retries >= 1 and degraded == 0
+                else:
+                    ok = verdict is True and degraded >= 1
+                results.append({
+                    "stage": stage,
+                    "kind": kind,
+                    "category": category,
+                    "verdict": verdict,
+                    "retries": retries,
+                    "degraded": degraded,
+                    "path": backend.last_path,
+                    "healthy_path": healthy_path,
+                    "error": error,
+                    "ok": ok,
+                })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+    return results
+
+
+def main() -> int:
+    json_mode = "--json" in sys.argv
+    stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
+    out = sys.stderr if json_mode else sys.stdout
+
+    import jax
+
+    print(f"device={jax.devices()[0].platform} "
+          f"cells={len(stages) * len(KINDS)}", file=out)
+    results = run_drill(stages=stages)
+    failed = [r for r in results if not r["ok"]]
+
+    header = (f"{'stage':14s} {'kind':16s} {'class':10s} {'verdict':8s} "
+              f"{'retries':8s} {'degraded':9s} {'path':18s} result")
+    print(header, file=out)
+    print("-" * len(header), file=out)
+    for r in results:
+        print(
+            f"{r['stage']:14s} {r['kind']:16s} {r['category']:10s} "
+            f"{str(r['verdict']):8s} {r['retries']:<8.0f} "
+            f"{r['degraded']:<9.0f} {str(r['path']):18s} "
+            f"{'PASS' if r['ok'] else 'FAIL' + (' ' + r['error'] if r['error'] else '')}",
+            file=out,
+        )
+    print(f"fault drill: {len(results) - len(failed)}/{len(results)} cells "
+          f"passed", file=out)
+    if json_mode:
+        print(json.dumps({
+            "metric": "fault_drill_cells_passed",
+            "value": len(results) - len(failed),
+            "unit": "cells",
+            "vs_baseline": 0.0,
+            "detail": {"cells": len(results), "results": results},
+        }), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
