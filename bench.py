@@ -103,6 +103,30 @@ def _resilience_detail() -> dict:
     }
 
 
+def _pipeline_detail() -> dict:
+    """{"pipeline": {...}} for EVERY emitted JSON line: whether the last
+    verify took the pipelined microbatch path, its chunk count and
+    overlap seconds (host pack time hidden behind device compute), and
+    the cross-call input-cache hit rates — so perf deltas between
+    pipeline-on and pipeline-off lines are attributable (ISSUE 4)."""
+    report = _stage_report() or {}
+    pipe = report.get("pipeline") or {}
+    caches = report.get("cache") or {}
+    return {
+        "pipeline": {
+            "enabled": bool(pipe.get("enabled")),
+            "chunks": pipe.get("chunks", 0),
+            "chunk_size": pipe.get("chunk_size"),
+            "overlap_s": pipe.get("overlap_s", 0.0),
+            "host_exposed_s": pipe.get("host_exposed_s", 0.0),
+            "cache_hit_rate": {
+                name: c.get("hit_rate", 0.0)
+                for name, c in caches.items()
+            },
+        }
+    }
+
+
 def _emit_fallback(err: str) -> None:
     """The always-parseable last-resort JSON line (metric matches the
     mode actually being run, so a slot-mode failure doesn't record a
@@ -125,6 +149,7 @@ def _emit_fallback(err: str) -> None:
         "error": err[:400],
     }
     line.update(_resilience_detail())
+    line.update(_pipeline_detail())
     stages = _stage_report()
     if stages is not None:
         line["stages"] = stages
@@ -189,6 +214,7 @@ def slot_chain_mode() -> None:
             "stages": _stage_report(),
             "device": jax.devices()[0].platform,
             **_resilience_detail(),
+            **_pipeline_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -331,10 +357,60 @@ def slot_mode() -> None:
             "stages": _stage_report(),
             "device": jax.devices()[0].platform,
             **_resilience_detail(),
+            **_pipeline_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
     _HEADLINE_EMITTED = True
+
+
+def _pipeline_cli_arg() -> str | None:
+    """Value of ``--pipeline`` (on | off | sweep), or None when absent.
+    A bare ``--pipeline`` means sweep (paired on+off lines)."""
+    if "--pipeline" not in sys.argv:
+        return None
+    i = sys.argv.index("--pipeline")
+    if i + 1 < len(sys.argv) and sys.argv[i + 1] in ("on", "off", "sweep"):
+        return sys.argv[i + 1]
+    return "sweep"
+
+
+def pipeline_sweep(backend, sets, reps: int, which: str) -> None:
+    """``--pipeline {on,off}`` sweep: time the synchronous e2e path with
+    the pipelined engine forced on and/or off and emit one
+    ``bls_pipeline_sweep`` JSON line per mode from a single run, each
+    carrying ``detail.pipeline`` — chunk count, overlap seconds, cache
+    hit rates — so the on/off perf delta is attributable."""
+    modes = ("off", "on") if which == "sweep" else (which,)
+    prev = os.environ.get("LHTPU_PIPELINE")
+    try:
+        for mode in modes:
+            os.environ["LHTPU_PIPELINE"] = "1" if mode == "on" else "0"
+            from lighthouse_tpu.common import pipeline as _pl
+
+            _pl.reset()  # else the off line reports the prior on-run
+            assert backend.verify_signature_sets(sets)  # warm (compiles)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                assert backend.verify_signature_sets(sets)
+            dt = (time.perf_counter() - t0) / reps
+            print(json.dumps({
+                "metric": "bls_pipeline_sweep",
+                "pipeline": mode,
+                "value": round(len(sets) / dt, 3),
+                "unit": "sets/sec",
+                "detail": {
+                    "batch_sets": len(sets),
+                    "e2e_sync_ms_per_batch": round(dt * 1e3, 2),
+                    "path": backend.last_path,
+                    **_pipeline_detail(),
+                },
+            }), flush=True)
+    finally:
+        if prev is None:
+            os.environ.pop("LHTPU_PIPELINE", None)
+        else:
+            os.environ["LHTPU_PIPELINE"] = prev
 
 
 def _vs_target(e2e_rate: float, native_rate: float | None, detail: dict) -> float:
@@ -620,7 +696,8 @@ def main() -> None:
                           "unit": "sets/sec", "vs_baseline": 0.0,
                           "error": "exactness gate failed",
                           "stages": _stage_report(),
-                          **_resilience_detail()}), flush=True)
+                          **_resilience_detail(),
+                          **_pipeline_detail()}), flush=True)
         _HEADLINE_EMITTED = True
         _INTENDED_RC = 1
         sys.exit(1)
@@ -657,6 +734,12 @@ def main() -> None:
     # device_sync, plus error and jit-cache attribution.
     headline_stages = _stage_report()
     headline_path = backend.last_path
+    headline_pipeline = _pipeline_detail()
+
+    # --- optional --pipeline {on,off} sweep (paired JSON lines) -------------
+    pipe_arg = _pipeline_cli_arg()
+    if pipe_arg is not None:
+        pipeline_sweep(backend, sets, REPS, pipe_arg)
 
     # --- measured native CPU baseline (C++; BASELINE.md mandate) ------------
     detail = {
@@ -709,6 +792,7 @@ def main() -> None:
     # Retry/degradation record for the whole run + the path the headline
     # batch actually took: a bench that survived a transient must SAY so.
     detail.update(_resilience_detail())
+    detail.update(headline_pipeline)
     detail["path"] = headline_path
 
     base = native_rate if native_rate else detail["cpu_python_sets_per_sec"]

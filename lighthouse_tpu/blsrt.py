@@ -22,9 +22,229 @@ explicit lane mask.
 
 from __future__ import annotations
 
+import os
+from collections import OrderedDict
+
 import numpy as np
 
+from .common.metrics import REGISTRY
 from .utils import next_pow2
+
+# ----------------------------------------------------------- input caches
+# Cross-call input caches (ISSUE 4 tentpole): steady-state slots repeat
+# the same validator pubkeys and the same attestation messages every
+# epoch, so the dispatch pack/hash stages keep re-deriving identical
+# device rows. Two bounded LRUs break that:
+#
+# * PUBKEY_ROW_CACHE — limbified affine rows keyed by raw pubkey bytes
+#   (falling back to the coordinate pair when the compressed form was
+#   never materialized). Rows live in a preallocated numpy arena so a
+#   warm batch rebuilds its [S, K] grid with one fancy-index gather
+#   instead of per-point Montgomery conversion.
+# * HTC_CACHE — hash-to-curve output rows keyed by message bytes (the
+#   persistent successor of _hash_message_bytes' per-call memo; ~8 ms
+#   of SHA+SSWU per distinct message on the oracle path).
+#
+# LHTPU_INPUT_CACHE=0 disables both; capacities via
+# LHTPU_PUBKEY_CACHE / LHTPU_HTC_CACHE. Traffic lands in
+# bls_input_cache_events_total{cache,event} and the per-cache entry
+# gauge, mirrored into dispatch_stage_report()["cache"] and bench
+# detail.stages.
+
+CACHE_EVENTS = REGISTRY.counter(
+    "bls_input_cache_events_total",
+    "Cross-call input cache traffic, by cache and event (hit/miss/evict)",
+    ("cache", "event"),
+)
+CACHE_ENTRIES = REGISTRY.gauge(
+    "bls_input_cache_entries",
+    "Entries resident in each cross-call input cache",
+    ("cache",),
+)
+
+
+def input_caches_enabled() -> bool:
+    return os.environ.get("LHTPU_INPUT_CACHE", "1") == "1"
+
+
+class InputCache:
+    """Bounded LRU of small host values with hit/miss/evict metrics."""
+
+    def __init__(self, name: str, env_var: str, default_capacity: int):
+        self.name = name
+        self._env_var = env_var
+        self._default_cap = default_capacity
+        self._data: OrderedDict = OrderedDict()
+
+    @property
+    def capacity(self) -> int:
+        try:
+            return max(1, int(os.environ.get(self._env_var, "")))
+        except ValueError:
+            return self._default_cap
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def get(self, key):
+        try:
+            val = self._data[key]
+        except KeyError:
+            CACHE_EVENTS.inc(cache=self.name, event="miss")
+            return None
+        self._data.move_to_end(key)
+        CACHE_EVENTS.inc(cache=self.name, event="hit")
+        return val
+
+    def put(self, key, value) -> None:
+        self._data[key] = value
+        self._data.move_to_end(key)
+        cap = self.capacity
+        while len(self._data) > cap:
+            self._data.popitem(last=False)
+            CACHE_EVENTS.inc(cache=self.name, event="evict")
+        CACHE_ENTRIES.set(len(self._data), cache=self.name)
+
+    def clear(self) -> None:
+        self._data.clear()
+        CACHE_ENTRIES.set(0, cache=self.name)
+
+
+class PubkeyRowCache:
+    """Bounded LRU of limbified pubkey rows in a numpy arena.
+
+    The LRU index maps a pubkey key -> arena slot; the arena holds the
+    Montgomery limb rows (int32[cap, 48] x/y planes + inf flags). A warm
+    batch resolves to slot indices in one Python pass and gathers rows
+    with two np.take calls — no bigint work at all."""
+
+    def __init__(self, name: str, env_var: str, default_capacity: int):
+        self.name = name
+        self._env_var = env_var
+        self._default_cap = default_capacity
+        self._slots: OrderedDict = OrderedDict()  # key -> arena row
+        self._free: list[int] = []
+        self._x = self._y = self._inf = None
+        self._cap = 0
+
+    @property
+    def capacity(self) -> int:
+        try:
+            return max(2, int(os.environ.get(self._env_var, "")))
+        except ValueError:
+            return self._default_cap
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def _ensure_arena(self) -> None:
+        cap = self.capacity
+        if self._x is None or cap != self._cap:
+            # capacity changed under us (env flip in tests): start clean
+            self._cap = cap
+            self._x = np.empty((cap, 48), np.int32)
+            self._y = np.empty((cap, 48), np.int32)
+            self._inf = np.empty((cap,), bool)
+            self._slots.clear()
+            self._free = list(range(cap))
+            CACHE_ENTRIES.set(0, cache=self.name)
+
+    def lookup(self, keys):
+        """keys -> (slot_idx int64[n] with -1 for misses, miss_positions).
+
+        Hits are refreshed to most-recently-used; hit/miss counters are
+        bumped once with batch amounts."""
+        self._ensure_arena()
+        idx = np.empty(len(keys), np.int64)
+        misses = []
+        slots = self._slots
+        for i, key in enumerate(keys):
+            slot = slots.get(key)
+            if slot is None:
+                idx[i] = -1
+                misses.append(i)
+            else:
+                slots.move_to_end(key)
+                idx[i] = slot
+        hits = len(keys) - len(misses)
+        if hits:
+            CACHE_EVENTS.inc(hits, cache=self.name, event="hit")
+        if misses:
+            CACHE_EVENTS.inc(len(misses), cache=self.name, event="miss")
+        return idx, misses
+
+    def insert(self, key, x_row, y_row, inf: bool) -> int:
+        """Store one row, evicting the LRU entry when full; returns the
+        arena slot the row landed in."""
+        self._ensure_arena()
+        slot = self._slots.get(key)
+        if slot is None:
+            if not self._free:
+                _, slot = self._slots.popitem(last=False)
+                CACHE_EVENTS.inc(cache=self.name, event="evict")
+            else:
+                slot = self._free.pop()
+            self._slots[key] = slot
+        else:
+            self._slots.move_to_end(key)
+        self._x[slot] = x_row
+        self._y[slot] = y_row
+        self._inf[slot] = inf
+        CACHE_ENTRIES.set(len(self._slots), cache=self.name)
+        return slot
+
+    def gather(self, idx):
+        """Arena rows for non-negative slot indices (int32 x, y, inf)."""
+        return (
+            self._x.take(idx, axis=0),
+            self._y.take(idx, axis=0),
+            self._inf.take(idx),
+        )
+
+    def clear(self) -> None:
+        self._slots.clear()
+        self._free = list(range(self._cap)) if self._x is not None else []
+        CACHE_ENTRIES.set(0, cache=self.name)
+
+
+PUBKEY_ROW_CACHE = PubkeyRowCache("pubkey_rows", "LHTPU_PUBKEY_CACHE", 65536)
+HTC_CACHE = InputCache("hash_to_curve", "LHTPU_HTC_CACHE", 4096)
+
+
+def pubkey_cache_key(pk):
+    """Raw compressed bytes when the key ever materialized them, else
+    the affine coordinate pair (both uniquely identify the point)."""
+    raw = getattr(pk, "_bytes", None)
+    if raw is not None:
+        return raw
+    p = pk.point
+    return (p.x.n, p.y.n)
+
+
+def reset_input_caches() -> None:
+    PUBKEY_ROW_CACHE.clear()
+    HTC_CACHE.clear()
+
+
+def input_cache_report() -> dict:
+    """Per-cache traffic snapshot (dispatch_stage_report / bench)."""
+    counts: dict[str, dict] = {}
+    for labels, value in CACHE_EVENTS.items():
+        entry = counts.setdefault(
+            labels["cache"], {"hit": 0.0, "miss": 0.0, "evict": 0.0}
+        )
+        entry[labels["event"]] = value
+    for name, cache in (
+        ("pubkey_rows", PUBKEY_ROW_CACHE),
+        ("hash_to_curve", HTC_CACHE),
+    ):
+        entry = counts.setdefault(
+            name, {"hit": 0.0, "miss": 0.0, "evict": 0.0}
+        )
+        entry["entries"] = len(cache)
+        seen = entry["hit"] + entry["miss"]
+        entry["hit_rate"] = round(entry["hit"] / seen, 4) if seen else 0.0
+    return counts
 
 
 class DevicePubkeyTable:

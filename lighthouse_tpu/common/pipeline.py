@@ -1,0 +1,156 @@
+"""Microbatch pipeline policy + accounting for the dispatch hot path.
+
+ISSUE 4 tentpole: above ``LHTPU_PIPELINE_MIN_SETS`` signature sets,
+``JaxBackend`` splits a batch into power-of-two chunks and runs a
+double-buffered pipeline — JAX's async dispatch executes chunk *i* on
+the device while the host packs/hashes/schedules chunk *i+1* through the
+existing stage wrappers (so retry + error attribution keep working per
+chunk). Verdicts combine through a device-side AND; only the final force
+pays a sync.
+
+This module owns the policy knobs (enable flag, threshold, chunk sizing)
+and the overlap accounting: host stage-time spent on chunk 0 is
+*exposed* (the device is idle until the first chunk is dispatched), host
+stage-time on every later chunk is *hidden* behind the device compute of
+the chunks already in flight. The hidden share is what the pipeline
+buys, exported as ``bls_pipeline_overlap_seconds``.
+
+Env knobs:
+
+* ``LHTPU_PIPELINE``           — ``0`` restores single-shot dispatch
+  (default ``1``).
+* ``LHTPU_PIPELINE_MIN_SETS``  — batches below this stay single-shot
+  (default 512; below that the stage histograms show host assembly is
+  too small to hide anything but compile-bucket churn).
+* ``LHTPU_PIPELINE_CHUNK``     — fixed power-of-two chunk size override;
+  default ``max(256, next_pow2(n) // 4)``, i.e. roughly four chunks in
+  flight so pack(i+1) has a full device verify to hide behind.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..utils import next_pow2
+from .metrics import REGISTRY
+
+PIPELINE_CHUNKS = REGISTRY.counter(
+    "bls_pipeline_chunks_total",
+    "Microbatch chunks dispatched by the pipelined verify path",
+)
+PIPELINE_OVERLAP = REGISTRY.counter(
+    "bls_pipeline_overlap_seconds",
+    "Host pack/hash/schedule seconds hidden behind device compute",
+)
+
+DEFAULT_MIN_SETS = 512
+MIN_CHUNK = 256
+
+
+def enabled() -> bool:
+    return os.environ.get("LHTPU_PIPELINE", "1") == "1"
+
+
+def min_sets() -> int:
+    try:
+        return max(2, int(os.environ.get("LHTPU_PIPELINE_MIN_SETS", "")))
+    except ValueError:
+        return DEFAULT_MIN_SETS
+
+
+def chunk_size(n: int) -> int:
+    """Power-of-two chunk size for an n-set batch."""
+    raw = os.environ.get("LHTPU_PIPELINE_CHUNK", "")
+    try:
+        return max(2, next_pow2(int(raw)))
+    except ValueError:
+        return max(MIN_CHUNK, next_pow2(n) // 4)
+
+
+def should_pipeline(n: int) -> bool:
+    return enabled() and n >= min_sets() and n > chunk_size(n)
+
+
+def split(sets: list) -> list:
+    """Split a batch into chunks of chunk_size(len(sets)) sets.
+
+    Every chunk but the last is exactly the chunk size (a single compile
+    bucket); the tail chunk pads inside _dispatch like any small batch.
+    """
+    step = chunk_size(len(sets))
+    return [sets[i:i + step] for i in range(0, len(sets), step)]
+
+
+class PipelineRun:
+    """Per-call accumulator for chunk counts and overlap seconds."""
+
+    def __init__(self, total_sets: int, n_chunks: int):
+        self.total_sets = total_sets
+        self.n_chunks = n_chunks
+        self.chunks_done = 0
+        self.host_exposed_s = 0.0
+        self.host_hidden_s = 0.0
+        self.stage_exposed_s: dict[str, float] = {}
+        self.stage_hidden_s: dict[str, float] = {}
+        self._t0 = time.perf_counter()
+
+    def note_chunk(self, stage_seconds: dict) -> None:
+        """Record one chunk's host-side stage seconds.
+
+        Chunk 0's host time is exposed — nothing is on the device yet.
+        Later chunks overlap the in-flight device work, so their host
+        time is the pipeline's hidden (saved) time.
+        """
+        first = self.chunks_done == 0
+        acc = self.stage_exposed_s if first else self.stage_hidden_s
+        host_s = 0.0
+        for k, v in stage_seconds.items():
+            if k == "device_sync":
+                continue
+            host_s += v
+            acc[k] = acc.get(k, 0.0) + v
+        if first:
+            self.host_exposed_s += host_s
+        else:
+            self.host_hidden_s += host_s
+            PIPELINE_OVERLAP.inc(host_s)
+        self.chunks_done += 1
+        PIPELINE_CHUNKS.inc()
+
+    def finish(self) -> dict:
+        stages = {
+            name: {
+                "exposed_s": round(self.stage_exposed_s.get(name, 0.0), 6),
+                "hidden_s": round(self.stage_hidden_s.get(name, 0.0), 6),
+            }
+            for name in (
+                set(self.stage_exposed_s) | set(self.stage_hidden_s)
+            )
+        }
+        report = {
+            "enabled": True,
+            "total_sets": self.total_sets,
+            "chunks": self.chunks_done,
+            "chunk_size": chunk_size(self.total_sets),
+            "host_exposed_s": round(self.host_exposed_s, 6),
+            "overlap_s": round(self.host_hidden_s, 6),
+            "wall_s": round(time.perf_counter() - self._t0, 6),
+            "stages": stages,
+        }
+        global _LAST_REPORT
+        _LAST_REPORT = report
+        return report
+
+
+_LAST_REPORT: dict = {"enabled": False, "chunks": 0, "overlap_s": 0.0}
+
+
+def last_run_report() -> dict:
+    """Snapshot of the most recent pipelined verify (stage report/bench)."""
+    return dict(_LAST_REPORT)
+
+
+def reset() -> None:
+    global _LAST_REPORT
+    _LAST_REPORT = {"enabled": False, "chunks": 0, "overlap_s": 0.0}

@@ -35,6 +35,7 @@ device-PRNG'd into the traced graph.
 
 from __future__ import annotations
 
+import os
 import secrets
 import time
 from contextlib import contextmanager
@@ -43,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .common import resilience, tracing
+from .common import pipeline, resilience, tracing
 from .common.logging import StructuredLogger
 from .common.metrics import REGISTRY
 from .crypto.bls.backends import register_backend
@@ -115,6 +116,15 @@ NATIVE_LOAD_FAILURES = REGISTRY.counter(
 )
 
 _LOG = StructuredLogger("jax_backend")
+
+# Host-fallback cost model: estimated native-backend wall time for a
+# batch, fit from the BASELINE bench configs on this pod's CPU (config
+# #3, one 512-key sync-committee set: 13.6 ms native; config #2 block
+# batches: ~3.3 ms per set plus ~0.05 ms per signing key). Batches whose
+# estimate beats LHTPU_HOST_FALLBACK_MS (default 250) skip the ~110 ms
+# device dispatch tunnel entirely.
+HOST_FALLBACK_MS_PER_SET = 3.3
+HOST_FALLBACK_MS_PER_KEY = 0.05
 
 # Most recent dispatch's stage timings / failure / path, for bench
 # attribution (bench.py reads these through dispatch_stage_report even
@@ -218,7 +228,15 @@ def dispatch_stage_report() -> dict:
         },
         "breaker": resilience.breaker_states(),
         "path": _LAST_PATH,
+        "pipeline": pipeline.last_run_report(),
+        "cache": _input_cache_report(),
     }
+
+
+def _input_cache_report() -> dict:
+    from . import blsrt
+
+    return blsrt.input_cache_report()
 
 
 _NATIVE_LOAD_WARNED: set[str] = set()
@@ -262,8 +280,6 @@ def _fused_choice() -> str:
     available and interpret-mode compile cost dominates, so classic
     stays the default there. LHTPU_FUSED_VERIFY=0/1 overrides. One
     policy shared by batch verify (_dispatch) and AggregateVerify."""
-    import os
-
     choice = os.environ.get("LHTPU_FUSED_VERIFY")
     if choice is None:
         choice = "1" if jax.default_backend() == "tpu" else "0"
@@ -277,8 +293,6 @@ def _host_agg_wanted(K: int, S: int, total_keys: int) -> bool:
     on CPU the device aggregation tree must keep its test coverage.
     LHTPU_HOST_AGG=0/1 overrides. Factored out so the production
     trigger (not just the override) is unit-testable (ADVICE r4)."""
-    import os
-
     if K <= 1:
         return False
     host_agg = os.environ.get("LHTPU_HOST_AGG")
@@ -730,8 +744,6 @@ class JaxBackend:
 
     @staticmethod
     def _use_device_htc() -> bool:
-        import os
-
         choice = os.environ.get("LHTPU_DEVICE_HTC")
         if choice is not None:
             return choice == "1"
@@ -777,7 +789,23 @@ class JaxBackend:
             minf = hinf[idx_d] | jnp.asarray(pad_inf)
             return mx, my, minf
 
-        memo = [hash_to_g2(m) for m in distinct]
+        # Oracle path: each distinct message costs ~8 ms of SHA+SSWU, and
+        # steady-state slots repeat the same messages every call — the
+        # memo is the bounded cross-call LRU in blsrt (ISSUE 4 satellite;
+        # the device-HTC path above keeps per-call dedup only: its
+        # outputs live on device and chain into the verify program).
+        from . import blsrt
+
+        if blsrt.input_caches_enabled():
+            memo = []
+            for m in distinct:
+                pt = blsrt.HTC_CACHE.get(m)
+                if pt is None:
+                    pt = hash_to_g2(m)
+                    blsrt.HTC_CACHE.put(m, pt)
+                memo.append(pt)
+        else:
+            memo = [hash_to_g2(m) for m in distinct]
         msgs = [memo[index[m]] for m in messages] + [inf2] * (S - n)
         return g2_to_dev(msgs)
 
@@ -788,7 +816,13 @@ class JaxBackend:
         degrades down the ladder fused → classic → native, so one PJRT
         tunnel hiccup no longer turns a verdict into a crash (the
         r03/r05 bench-zeroing class). LHTPU_RESILIENCE=0 restores the
-        raw raise-through behavior."""
+        raw raise-through behavior.
+
+        Batches of LHTPU_PIPELINE_MIN_SETS sets or more take the
+        pipelined microbatch engine (LHTPU_PIPELINE=0 restores
+        single-shot dispatch; verdicts are bit-identical either way)."""
+        if pipeline.should_pipeline(len(sets)):
+            return self._verify_pipelined(sets)
         if not resilience.enabled():
             out = self._dispatch(sets)
             if isinstance(out, bool):
@@ -848,6 +882,125 @@ class JaxBackend:
                 return self._verify_resilient(sets)
 
         return resolve
+
+    # ---------------------------------------------- pipelined dispatch
+
+    def _verify_pipelined(self, sets) -> bool:
+        """Double-buffered microbatch dispatch (ISSUE 4 tentpole).
+
+        The batch is split into power-of-two chunks
+        (common/pipeline.py); each chunk runs through the SAME _dispatch
+        — pack / hash_to_curve / scalars / msm_schedule stage wrappers,
+        per-stage transient retry, error attribution — but its verdict
+        scalar is left un-forced. JAX dispatch is asynchronous, so while
+        the device executes chunk i's verify program the host is already
+        packing chunk i+1: that host time is hidden behind device
+        compute and lands in bls_pipeline_overlap_seconds. Verdicts
+        combine through a device-side AND; only the final force pays a
+        sync.
+
+        Resilience composes per chunk exactly like a whole-batch call:
+        a chunk whose dispatch raises feeds the rung's breaker and
+        degrades down the ladder via _verify_resilient; an open breaker
+        routes the chunk straight to the degraded rungs; a transient
+        failure at the final force re-dispatches every in-flight chunk
+        (the failed async buffers are poisoned), a permanent one
+        degrades all of them."""
+        global _LAST_STAGES, _LAST_PATH
+        chunks = pipeline.split(sets)
+        run = pipeline.PipelineRun(len(sets), len(chunks))
+        combined: dict[str, float] = {}
+        res_on = resilience.enabled()
+        pending: list = []  # chunks whose device scalar is in flight
+        acc = None          # device-side AND of in-flight verdicts
+        host_false = False  # a structurally/degraded-False chunk
+        for chunk in chunks:
+            out = None
+            if res_on:
+                br = resilience.breaker(self._ladder()[0])
+                if not br.allow():
+                    # Open breaker: degrade this chunk without
+                    # attempting the primary rung, like a whole-batch
+                    # call would.
+                    if not self._verify_resilient(chunk):
+                        host_false = True
+                else:
+                    try:
+                        out = self._dispatch(chunk)
+                    except Exception as exc:
+                        self._record_rung_failure(exc)
+                        if not self._verify_resilient(chunk):
+                            host_false = True
+            else:
+                out = self._dispatch(chunk)
+            for k, v in self.last_stage_seconds.items():
+                combined[k] = combined.get(k, 0.0) + v
+            run.note_chunk(self.last_stage_seconds)
+            if isinstance(out, bool) and not out:
+                host_false = True
+            elif out is not None and not isinstance(out, bool):
+                acc = out if acc is None else jnp.logical_and(acc, out)
+                pending.append(chunk)
+            if host_false:
+                break  # one False chunk decides the whole batch
+
+        verdict = not host_false
+        if verdict and acc is not None:
+            verdict = self._force_pipelined(acc, pending, combined)
+
+        _LAST_STAGES = combined
+        self.last_stage_seconds = combined
+        self.last_path = (self.last_path or "") + "+pipeline"
+        _LAST_PATH = self.last_path
+        run.finish()
+        return verdict
+
+    def _force_pipelined(self, acc, pending, stages) -> bool:
+        """Force the combined device verdict, with _verify_once's
+        device_sync semantics: transient failures re-dispatch the
+        in-flight chunks under the bounded retry policy, anything else
+        trips the breaker and degrades every pending chunk."""
+        res_on = resilience.enabled()
+        policy = resilience.retry_policy()
+        attempt = 0
+        while True:
+            try:
+                with _stage("device_sync", stages):
+                    if res_on:
+                        verdict = bool(
+                            resilience.force_with_deadline(lambda: bool(acc))
+                        )
+                    else:
+                        return bool(acc)
+                rung = self._last_rung or self._ladder()[0]
+                resilience.breaker(rung).record_success()
+                return verdict
+            except Exception as exc:
+                if not res_on:
+                    raise
+                category, kind = resilience.classify(exc)
+                if (category != resilience.TRANSIENT
+                        or attempt >= policy.max_retries):
+                    self._record_rung_failure(exc)
+                    return all(
+                        self._verify_resilient(c) for c in pending
+                    )
+                attempt += 1
+                resilience.RETRIES_TOTAL.inc(stage="device_sync", kind=kind)
+                policy.sleep(attempt)
+                acc = None
+                for chunk in pending:
+                    out = self._dispatch(chunk)
+                    if isinstance(out, bool):
+                        if not out:
+                            return False
+                    else:
+                        acc = (
+                            out if acc is None
+                            else jnp.logical_and(acc, out)
+                        )
+                if acc is None:
+                    return True
 
     # ------------------------------------------------ resilience ladder
     # Which rung the last _dispatch ran on ("fused" | "classic" |
@@ -989,8 +1142,6 @@ class JaxBackend:
             if s.signature.is_infinity():
                 return False
 
-        import os
-
         n = len(sets)
         total_keys = sum(len(s.signing_keys) for s in sets)
         DISPATCH_BATCH_SETS.observe(n)
@@ -1014,7 +1165,10 @@ class JaxBackend:
             and os.environ.get("LHTPU_HOST_FALLBACK", "1") == "1"
             and jax.default_backend() == "tpu"
         ):
-            est_native_ms = 3.3 * n + 0.05 * total_keys
+            est_native_ms = (
+                HOST_FALLBACK_MS_PER_SET * n
+                + HOST_FALLBACK_MS_PER_KEY * total_keys
+            )
             if est_native_ms < float(
                 os.environ.get("LHTPU_HOST_FALLBACK_MS", "250")
             ):
@@ -1093,17 +1247,12 @@ class JaxBackend:
                         [i for _, _, i in agg], dtype=bool
                     ).reshape(S, 1)
                 else:
-                    # Pubkeys: [S, K] affine grid, padding at infinity.
-                    pk_rows = []
-                    for s in sets:
-                        row = [pk.point for pk in s.signing_keys]
-                        row += [inf1] * (K - len(row))
-                        pk_rows.append(row)
-                    pk_rows += [[inf1] * K] * (S - n)
-                    flat = [p for row in pk_rows for p in row]
-                    px, py, pinf = g1_to_dev(flat)
-                    px, py = px.reshape(S, K, 48), py.reshape(S, K, 48)
-                    pinf = pinf.reshape(S, K)
+                    # Pubkeys: [S, K] affine grid, padding at infinity
+                    # (rows come from the cross-call limb cache when
+                    # enabled — validators repeat every epoch).
+                    px, py, pinf = self._pack_pubkey_grid(
+                        sets, S, K, n, inf1
+                    )
 
             sigs = [s.signature.point for s in sets] + [inf2] * (S - n)
             sx, sy, sinf = g2_to_dev(sigs)
@@ -1208,6 +1357,77 @@ class JaxBackend:
         _LAST_PATH = self.last_path
         DISPATCH_BATCHES.inc(path=self.last_path)
         return ok
+
+    @staticmethod
+    def _pack_pubkey_grid(sets, S: int, K: int, n: int, inf1):
+        """[S, K] pubkey limb grid, padding lanes at infinity.
+
+        With the cross-call cache enabled (LHTPU_INPUT_CACHE, default
+        on), each distinct pubkey's Montgomery limb rows are limbified
+        once and parked in blsrt.PUBKEY_ROW_CACHE's numpy arena; a warm
+        batch rebuilds the grid with dict lookups plus one fancy-index
+        gather — no bigint math. Misses are limbified in ONE vectorized
+        g1_to_dev batch, so the cold path is exactly the uncached path
+        plus the insert (bit-identical rows either way). Padding lanes
+        are zero-coordinate infinity, which is precisely what
+        g1_to_dev(inf1) produces."""
+        from . import blsrt
+
+        if not blsrt.input_caches_enabled():
+            pk_rows = []
+            for s in sets:
+                row = [pk.point for pk in s.signing_keys]
+                row += [inf1] * (K - len(row))
+                pk_rows.append(row)
+            pk_rows += [[inf1] * K] * (S - n)
+            flat = [p for row in pk_rows for p in row]
+            px, py, pinf = g1_to_dev(flat)
+            return (
+                px.reshape(S, K, 48),
+                py.reshape(S, K, 48),
+                pinf.reshape(S, K),
+            )
+
+        cache = blsrt.PUBKEY_ROW_CACHE
+        flat_pks = [pk for s in sets for pk in s.signing_keys]
+        # serialized-bytes keys straight off the lazy-deserialize slot;
+        # fall back to coordinate tuples for keys built from raw points
+        keys = [pk._bytes for pk in flat_pks]
+        if any(k is None for k in keys):
+            keys = [blsrt.pubkey_cache_key(pk) for pk in flat_pks]
+        idx, misses = cache.lookup(keys)
+        if misses:
+            mx, my, minf = g1_to_dev([flat_pks[i].point for i in misses])
+            for j, i in enumerate(misses):
+                idx[i] = cache.insert(
+                    keys[i], mx[j], my[j], bool(minf[j])
+                )
+        gx, gy, ginf = cache.gather(idx)
+        if len(flat_pks) == S * K:
+            # every lane is a real key (uniform-K, no row padding): the
+            # gather IS the grid, skip the zero-fill + scatter
+            return (
+                gx.reshape(S, K, 48),
+                gy.reshape(S, K, 48),
+                ginf.reshape(S, K),
+            )
+        px = np.zeros((S * K, 48), np.int32)
+        py = np.zeros((S * K, 48), np.int32)
+        pinf = np.ones((S * K,), bool)
+        pos = [
+            si * K + ki
+            for si, s in enumerate(sets)
+            for ki in range(len(s.signing_keys))
+        ]
+        pos_a = np.asarray(pos, np.int64)
+        px[pos_a] = gx
+        py[pos_a] = gy
+        pinf[pos_a] = ginf
+        return (
+            px.reshape(S, K, 48),
+            py.reshape(S, K, 48),
+            pinf.reshape(S, K),
+        )
 
     @staticmethod
     def _host_aggregate_rows(sets, S: int):

@@ -69,9 +69,129 @@ def limbs_to_int(limbs) -> int:
 def ints_to_limbs(xs) -> np.ndarray:
     """Host-side batch conversion: iterable of ints -> int32[n, 48]."""
     xs = list(xs)
-    buf = b"".join(int(x).to_bytes(N_LIMBS, "little") for x in xs)
+    buf = _ints_to_bytes(xs)
     return (
         np.frombuffer(buf, dtype=np.uint8).astype(np.int32).reshape(len(xs), N_LIMBS)
+    )
+
+
+def _ints_to_bytes(xs: list) -> bytes:
+    """One concatenated little-endian 48-byte buffer for a list of ints.
+
+    ``map`` over the unbound C method skips per-element bytecode — this
+    join is the irreducible Python cost of every host->device batch."""
+    from itertools import repeat
+
+    try:
+        return b"".join(map(int.to_bytes, xs, repeat(N_LIMBS), repeat("little")))
+    except TypeError:
+        # non-int field wrappers: fall back to the casting path
+        return b"".join(int(x).to_bytes(N_LIMBS, "little") for x in xs)
+
+
+# --- vectorized host-side to-Montgomery conversion ------------------------
+# ints_to_limbs_mont() computes limbs(v * 2^384 mod p) for a whole batch
+# without any per-int Python bigint work. Strategy: split each v < p into
+# 24 base-2^16 words u_i (one np.frombuffer over the concatenated byte
+# buffer), then
+#
+#     v * 2^384 mod p  ==  sum_i u_i * W_i  -  q * p,   q = floor(V / p)
+#
+# with W_i = 2^(16*i + 384) mod p precomputed as 12 base-2^32 words. The
+# accumulation T = u @ WMAT is ONE float64 matmul whose every partial is
+# exact: products < 2^16 * 2^32 = 2^48 and 24-term column sums stay under
+# 24 * 2^48 < 2^53. The quotient of the small residual V < 24 * 2^16 * p
+# is estimated with a float dot (error well under 1/2 ulp of an integer,
+# so q_est is off by at most one — fixed up after normalization), and a
+# short signed base-2^32 carry loop canonicalizes the 12 columns, which
+# then ARE the 48 output limbs via a little-endian byte view.
+
+_MONT_WMAT = np.zeros((24, 12), np.float64)
+for _i in range(24):
+    _w = (1 << (16 * _i + R_BITS)) % P
+    for _k in range(12):
+        _MONT_WMAT[_i, _k] = (_w >> (32 * _k)) & 0xFFFFFFFF
+_P32F = np.array(
+    [(P >> (32 * _k)) & 0xFFFFFFFF for _k in range(12)], np.float64
+)
+# 2^(32k)/p rounded to f64 — quotient-estimate weights for the T columns
+_POW32_OVER_P = np.array(
+    [float((1 << (32 * _k + 100)) // P) * 2.0 ** -100 for _k in range(12)],
+    np.float64,
+)
+_TWO32 = 2.0 ** 32
+_INV32 = 2.0 ** -32
+# top base-2^32 word of p — prefilter for the rare >= p fixup check
+_PTOPF = float(P >> (32 * 11))
+del _i, _k, _w
+
+
+def _carry_rows_f64(D: np.ndarray) -> None:
+    """In-place signed base-2^32 carry normalization of float64 digit
+    columns (exact: all values stay far below 2^53). Converges in a few
+    passes; the top column accumulates the signed overflow."""
+    c = np.empty_like(D)
+    t = np.empty_like(D)
+    while True:
+        np.multiply(D, _INV32, out=c)
+        np.floor(c, out=c)
+        c[:, -1] = 0.0  # the top column keeps its sign until fixup
+        if not c.any():
+            return
+        np.multiply(c, _TWO32, out=t)
+        np.subtract(D, t, out=D)
+        D[:, 1:] += c[:, :-1]
+
+
+def ints_to_limbs_mont(xs) -> np.ndarray:
+    """Host-side batch to-MONTGOMERY conversion: iterable of standard-
+    domain ints in [0, p) -> int32[n, 48] limbs of (v * R) mod p.
+
+    Vectorized replacement for ``ints_to_limbs([(v * R_MONT) % P ...])``
+    — the per-int bigint mulmod loop that dominated the dispatch pack
+    stage (see the module comment above _MONT_WMAT for the math)."""
+    xs = list(xs)
+    n = len(xs)
+    if n == 0:
+        return np.zeros((0, N_LIMBS), np.int32)
+    buf = _ints_to_bytes(xs)
+    u16 = np.frombuffer(buf, dtype="<u2").reshape(n, 24).astype(np.float64)
+    T = u16 @ _MONT_WMAT                      # [n, 12] base-2^32, exact
+    q = np.floor(T @ _POW32_OVER_P)           # ~V/p, off by at most 1
+    D = np.empty((n, 13))
+    D[:, 12] = 0.0
+    np.multiply(q[:, None], _P32F[None, :], out=D[:, :12])
+    np.subtract(T, D[:, :12], out=D[:, :12])
+    _carry_rows_f64(D)
+    # q off-by-one fixup: a negative top column means q was one too big
+    # (add p back); otherwise a >= p check catches q one too small. At
+    # most one correction each way ever fires, and almost never does —
+    # the full lexicographic compare only runs on rows whose top word
+    # reaches p's (a ~2^-30 coincidence for reduced values).
+    while True:
+        neg = D[:, 12] < 0
+        if neg.any():
+            # value is digits - 2^384: adding p overflows the digit
+            # columns and the resulting carry restores the top to 0
+            D[neg, :12] += _P32F
+            _carry_rows_f64(D)
+            continue
+        cand = D[:, 11] >= _PTOPF
+        if not cand.any():
+            break
+        diff = D[cand, :12] - _P32F[None, :]
+        nz = diff != 0
+        has = nz.any(axis=1)
+        top = 11 - np.argmax(nz[:, ::-1], axis=1)
+        ge = (~has) | (has & (diff[np.arange(diff.shape[0]), top] > 0))
+        if not ge.any():
+            break
+        rows = np.flatnonzero(cand)[ge]
+        D[rows, :12] -= _P32F
+        _carry_rows_f64(D)
+    return (
+        D[:, :12].astype("<u4").view(np.uint8).astype(np.int32)
+        .reshape(n, N_LIMBS)
     )
 
 

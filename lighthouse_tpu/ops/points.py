@@ -400,7 +400,18 @@ def pt_tree_sum_axis(F, P, axis: int, axis_size: int):
 
 
 def _mont_batch(ints) -> np.ndarray:
-    """Host ints (standard domain) -> Montgomery limb batch [n, 48]."""
+    """Host ints (standard domain) -> Montgomery limb batch [n, 48].
+
+    Vectorized: one concatenated byte buffer -> np.frombuffer
+    limbification + float64 matrix Montgomery conversion (see
+    limb.ints_to_limbs_mont). Byte-identical to _mont_batch_reference,
+    which keeps the original per-int bigint loop as the golden oracle.
+    """
+    return limb.ints_to_limbs_mont(ints)
+
+
+def _mont_batch_reference(ints) -> np.ndarray:
+    """Original per-int Python loop — golden oracle for _mont_batch."""
     from ..crypto.bls.constants import P as _P
 
     R = limb.R_MONT

@@ -260,3 +260,71 @@ def test_host_aggregation_heuristic_trigger(monkeypatch):
     backend = jb.JaxBackend()
     assert backend.verify_signature_sets(sets)
     assert backend.last_path.endswith("+host-agg")
+
+
+def test_table_gather_args_edge_cases():
+    """_table_gather_args must decline (return None) — never raise — on
+    registries that can't serve the batch, so dispatch falls back to the
+    host-coordinate pack path (ISSUE 4 satellite)."""
+    import numpy as np
+
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.jax_backend import JaxBackend
+
+    gather = JaxBackend._table_gather_args
+    sets = [
+        SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[0], M0, index=0),
+        SignatureSet.multiple_pubkeys(
+            AggregateSignature.aggregate([SKS[1].sign(M1), SKS[2].sign(M1)]),
+            [PKS[1], PKS[2]],
+            M1,
+            indices=[1, 2],
+        ),
+    ]
+    prev = blsrt.get_device_table()
+    try:
+        # no registry at all
+        blsrt.set_device_table(None)
+        assert gather(sets, 2, 2) is None
+
+        # registered but empty table
+        blsrt.set_device_table(blsrt.DevicePubkeyTable())
+        assert gather(sets, 2, 2) is None
+
+        # table too short for the referenced validator indices
+        short = blsrt.DevicePubkeyTable()
+        short.append_pubkeys(PKS[:2])  # rows 0..1, sets reference index 2
+        blsrt.set_device_table(short)
+        assert gather(sets, 2, 2) is None
+
+        # index list length disagrees with the key list
+        table = blsrt.DevicePubkeyTable()
+        table.append_pubkeys(PKS)
+        blsrt.set_device_table(table)
+        bad = [
+            sets[0],
+            SignatureSet.multiple_pubkeys(
+                AggregateSignature.aggregate(
+                    [SKS[1].sign(M1), SKS[2].sign(M1)]
+                ),
+                [PKS[1], PKS[2]],
+                M1,
+                indices=[1],
+            ),
+        ]
+        assert gather(bad, 2, 2) is None
+
+        # a set with no indices at all opts the whole batch out
+        no_idx = [sets[0], _valid_sets()[1]]
+        assert gather(no_idx, 2, 2) is None
+
+        # positive control: the same batch with a covering table gathers
+        out = gather(sets, 2, 2)
+        assert out is not None
+        tx, ty, idx, inf = out
+        assert idx.shape == (2, 2) and inf.shape == (2, 2)
+        assert idx.dtype == np.int32
+        assert list(idx[0]) == [0, 0] and list(inf[0]) == [False, True]
+        assert list(idx[1]) == [1, 2] and not inf[1].any()
+    finally:
+        blsrt.set_device_table(prev)

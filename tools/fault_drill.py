@@ -65,10 +65,18 @@ def _total(counter) -> float:
     return sum(v for _, v in counter.items())
 
 
-def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None):
+def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None,
+              pipelined: bool = False):
     """Run the injection matrix; returns a list of per-cell dicts with
     an ``ok`` verdict each. Restores the env and resilience state it
-    touched (safe to call from tests)."""
+    touched (safe to call from tests).
+
+    ``pipelined=True`` drills the microbatch pipeline instead of the
+    single-shot dispatch: the batch is doubled to two chunks and
+    LHTPU_PIPELINE forced on with a 2-set chunk size, so per-chunk
+    retries and mid-pipeline breaker trips meet the SAME contract (each
+    chunk stays in the (S=2, K=2) compile bucket the fast tier pays
+    for)."""
     from lighthouse_tpu.common import resilience
     from lighthouse_tpu.jax_backend import JaxBackend
 
@@ -76,13 +84,23 @@ def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None):
         backend = JaxBackend()
     if sets is None:
         sets = _mk_sets()
+        if pipelined:
+            sets = sets + _mk_sets()  # two chunks of the same bucket
 
     saved = {
         k: os.environ.get(k)
-        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS")
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_PIPELINE_MIN_SETS",
+                  "LHTPU_PIPELINE_CHUNK")
     }
     os.environ["LHTPU_RETRY_BASE_MS"] = "0"  # no backoff sleeps in a drill
     os.environ.pop("LHTPU_FAULT_INJECT", None)
+    if pipelined:
+        os.environ["LHTPU_PIPELINE"] = "1"
+        os.environ["LHTPU_PIPELINE_MIN_SETS"] = "2"
+        os.environ["LHTPU_PIPELINE_CHUNK"] = "2"
+    else:
+        os.environ["LHTPU_PIPELINE"] = "0"
     results = []
     try:
         # Healthy warm pass: pays the one compile and pins the baseline
@@ -111,6 +129,7 @@ def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None):
                 else:
                     ok = verdict is True and degraded >= 1
                 results.append({
+                    "mode": "pipelined" if pipelined else "single-shot",
                     "stage": stage,
                     "kind": kind,
                     "category": category,
@@ -140,19 +159,25 @@ def main() -> int:
     import jax
 
     print(f"device={jax.devices()[0].platform} "
-          f"cells={len(stages) * len(KINDS)}", file=out)
+          f"cells={(len(stages) + len(QUICK_STAGES)) * len(KINDS)}",
+          file=out)
     results = run_drill(stages=stages)
+    # Pipelined matrix (3-stage subset): per-chunk retry and
+    # mid-pipeline breaker trips must meet the same contract.
+    results += run_drill(stages=QUICK_STAGES, pipelined=True)
     failed = [r for r in results if not r["ok"]]
 
-    header = (f"{'stage':14s} {'kind':16s} {'class':10s} {'verdict':8s} "
-              f"{'retries':8s} {'degraded':9s} {'path':18s} result")
+    header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "
+              f"{'verdict':8s} {'retries':8s} {'degraded':9s} "
+              f"{'path':22s} result")
     print(header, file=out)
     print("-" * len(header), file=out)
     for r in results:
         print(
-            f"{r['stage']:14s} {r['kind']:16s} {r['category']:10s} "
+            f"{r['mode']:12s} {r['stage']:14s} {r['kind']:16s} "
+            f"{r['category']:10s} "
             f"{str(r['verdict']):8s} {r['retries']:<8.0f} "
-            f"{r['degraded']:<9.0f} {str(r['path']):18s} "
+            f"{r['degraded']:<9.0f} {str(r['path']):22s} "
             f"{'PASS' if r['ok'] else 'FAIL' + (' ' + r['error'] if r['error'] else '')}",
             file=out,
         )

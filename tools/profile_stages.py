@@ -194,13 +194,52 @@ def main():
     timeit("to_affine_g2 (hash out)", lambda: tc.to_affine_g2_t(Qc))
     timeit("hash full _map_to_g2_fused", lambda: _map_to_g2_fused(u))
 
+    # ------------------------------------------- pipelined overlap report
+    # One end-to-end verify through the pipelined microbatch engine
+    # (common/pipeline.py): per host stage, how many seconds ran hidden
+    # behind device compute vs exposed in front of it. Skipped when the
+    # pipeline is disabled or S is below LHTPU_PIPELINE_MIN_SETS.
+    overlap = profile_pipeline_overlap(sets)
+
     if JSON_MODE:
         print(json.dumps({
             "metric": "bls_stage_profile",
             "stages_ms": STAGES_MS,
             "detail": {"S": S, "K": K,
-                       "device": jax.devices()[0].platform},
+                       "device": jax.devices()[0].platform,
+                       "overlap": overlap},
         }), flush=True)
+
+
+def profile_pipeline_overlap(sets) -> dict:
+    """Run one pipelined verify and report host-hidden vs host-exposed
+    seconds per dispatch stage (None-shaped dict when the batch doesn't
+    pipeline). Warm-path numbers: the first call pays compiles and cold
+    caches, the second is the steady state the pipeline targets."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import pipeline as pl
+
+    out = sys.stderr if JSON_MODE else sys.stdout
+    if not pl.should_pipeline(len(sets)):
+        print(f"pipeline: skipped (enabled={pl.enabled()} "
+              f"S={len(sets)} min_sets={pl.min_sets()})", file=out)
+        return {"enabled": False}
+
+    be = jb.JaxBackend()
+    assert be.verify_signature_sets(sets)   # compiles + cold caches
+    t0 = time.perf_counter()
+    assert be.verify_signature_sets(sets)   # steady state
+    wall = time.perf_counter() - t0
+    pipe = jb.dispatch_stage_report().get("pipeline") or {}
+    record("pipelined e2e (warm)", wall * 1e3)
+    print(f"pipeline: chunks={pipe.get('chunks')} "
+          f"chunk_size={pipe.get('chunk_size')} "
+          f"overlap={pipe.get('overlap_s')}s "
+          f"exposed={pipe.get('host_exposed_s')}s", file=out)
+    for stage, d in sorted((pipe.get("stages") or {}).items()):
+        print(f"  {stage:20s} hidden {d['hidden_s']*1e3:8.1f} ms   "
+              f"exposed {d['exposed_s']*1e3:8.1f} ms", file=out)
+    return pipe
 
 
 if __name__ == "__main__":
