@@ -212,13 +212,12 @@ HTC_CACHE = InputCache("hash_to_curve", "LHTPU_HTC_CACHE", 4096)
 
 
 def pubkey_cache_key(pk):
-    """Raw compressed bytes when the key ever materialized them, else
-    the affine coordinate pair (both uniquely identify the point)."""
-    raw = getattr(pk, "_bytes", None)
-    if raw is not None:
-        return raw
-    p = pk.point
-    return (p.x.n, p.y.n)
+    """Canonical cache key: the compressed serialization. Cheap to
+    derive from affine (sign flag + x bytes, no modular sqrt) and
+    memoized on the key object by ``to_bytes``, so a given point maps
+    to exactly ONE arena row whether it was built from bytes or from a
+    raw point — mixed forms never duplicate entries."""
+    return pk.to_bytes()
 
 
 def reset_input_caches() -> None:

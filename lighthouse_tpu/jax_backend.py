@@ -989,17 +989,33 @@ class JaxBackend:
                 resilience.RETRIES_TOTAL.inc(stage="device_sync", kind=kind)
                 policy.sleep(attempt)
                 acc = None
-                for chunk in pending:
-                    out = self._dispatch(chunk)
-                    if isinstance(out, bool):
-                        if not out:
-                            return False
-                    else:
-                        acc = (
-                            out if acc is None
-                            else jnp.logical_and(acc, out)
-                        )
+                try:
+                    for chunk in pending:
+                        out = self._dispatch(chunk)
+                        if isinstance(out, bool):
+                            if not out:
+                                return False
+                        else:
+                            acc = (
+                                out if acc is None
+                                else jnp.logical_and(acc, out)
+                            )
+                except Exception as redispatch_exc:
+                    # The re-dispatch died with the device still sick:
+                    # degrade every pending chunk down the ladder, same
+                    # as the non-transient branch (and as _verify_once's
+                    # re-dispatch failures, caught by _verify_resilient).
+                    self._record_rung_failure(redispatch_exc)
+                    return all(
+                        self._verify_resilient(c) for c in pending
+                    )
                 if acc is None:
+                    # Every chunk resolved to a host bool — a recovered
+                    # call with no further force, so the rung's breaker
+                    # records the success here (the acc path records it
+                    # at the next successful force above).
+                    rung = self._last_rung or self._ladder()[0]
+                    resilience.breaker(rung).record_success()
                     return True
 
     # ------------------------------------------------ resilience ladder
@@ -1370,10 +1386,35 @@ class JaxBackend:
         g1_to_dev batch, so the cold path is exactly the uncached path
         plus the insert (bit-identical rows either way). Padding lanes
         are zero-coordinate infinity, which is precisely what
-        g1_to_dev(inf1) produces."""
+        g1_to_dev(inf1) produces.
+
+        A batch with more DISTINCT keys than the arena has slots cannot
+        go through insert-then-gather: the miss-insert loop's LRU
+        evictions would reuse slots already recorded in idx (batch hits
+        or earlier misses) before the gather runs, silently corrupting
+        the grid. Such batches build uncached (counted as ``bypass``
+        cache events). Within capacity the order is safe: lookup
+        refreshes every batch hit to MRU and inserts land MRU, so
+        evictions only ever claim rows no lane of this batch
+        references."""
         from . import blsrt
 
-        if not blsrt.input_caches_enabled():
+        keys = None
+        if blsrt.input_caches_enabled():
+            cache = blsrt.PUBKEY_ROW_CACHE
+            flat_pks = [pk for s in sets for pk in s.signing_keys]
+            # serialized-bytes keys straight off the lazy-deserialize
+            # slot; pubkey_cache_key derives (and memoizes) the same
+            # canonical form for keys built from raw points
+            keys = [pk._bytes for pk in flat_pks]
+            if any(k is None for k in keys):
+                keys = [blsrt.pubkey_cache_key(pk) for pk in flat_pks]
+            if len(set(keys)) > cache.capacity:
+                blsrt.CACHE_EVENTS.inc(
+                    len(keys), cache=cache.name, event="bypass"
+                )
+                keys = None
+        if keys is None:
             pk_rows = []
             for s in sets:
                 row = [pk.point for pk in s.signing_keys]
@@ -1388,13 +1429,6 @@ class JaxBackend:
                 pinf.reshape(S, K),
             )
 
-        cache = blsrt.PUBKEY_ROW_CACHE
-        flat_pks = [pk for s in sets for pk in s.signing_keys]
-        # serialized-bytes keys straight off the lazy-deserialize slot;
-        # fall back to coordinate tuples for keys built from raw points
-        keys = [pk._bytes for pk in flat_pks]
-        if any(k is None for k in keys):
-            keys = [blsrt.pubkey_cache_key(pk) for pk in flat_pks]
         idx, misses = cache.lookup(keys)
         if misses:
             mx, my, minf = g1_to_dev([flat_pks[i].point for i in misses])

@@ -148,6 +148,67 @@ def test_pipeline_matches_under_fault_injection(monkeypatch, spec, expect):
     assert be.verify_signature_sets(sets) is True
 
 
+class _FailingForce:
+    """Device verdict stand-in whose force raises a transient once,
+    then (if reached again) resolves True."""
+
+    def __init__(self):
+        self.raised = False
+
+    def __bool__(self):
+        if not self.raised:
+            self.raised = True
+            raise ConnectionError("socket reset during force")
+        return True
+
+
+def test_force_pipelined_redispatch_failure_degrades(monkeypatch):
+    """If the transient-retry re-dispatch itself dies (same device
+    fault that poisoned the force), the pipelined force degrades every
+    pending chunk down the ladder instead of raising out of
+    verify_signature_sets."""
+    monkeypatch.setenv("LHTPU_RESILIENCE", "1")
+    monkeypatch.setenv("LHTPU_RETRY_BASE_MS", "0")
+    resilience.reset()
+    be = jb.JaxBackend()
+    calls = {"dispatch": 0, "resilient": []}
+
+    def boom(chunk, path_override=None):
+        calls["dispatch"] += 1
+        raise ConnectionError("connection reset: device still down")
+
+    monkeypatch.setattr(be, "_dispatch", boom)
+    monkeypatch.setattr(
+        be,
+        "_verify_resilient",
+        lambda c: calls["resilient"].append(c) or True,
+    )
+    pending = [["chunk0"], ["chunk1"]]
+    assert be._force_pipelined(_FailingForce(), pending, {}) is True
+    assert calls["dispatch"] == 1  # first re-dispatch raised
+    assert calls["resilient"] == pending
+    resilience.reset()
+
+
+def test_force_pipelined_all_bool_recovery_records_success(monkeypatch):
+    """A transient force failure recovered entirely by host-bool
+    re-dispatches records a breaker success, like _verify_once's
+    recovered calls do."""
+    monkeypatch.setenv("LHTPU_RESILIENCE", "1")
+    monkeypatch.setenv("LHTPU_RETRY_BASE_MS", "0")
+    resilience.reset()
+    be = jb.JaxBackend()
+    monkeypatch.setattr(
+        be, "_dispatch", lambda chunk, path_override=None: True
+    )
+    rung = be._ladder()[0]
+    br = resilience.breaker(rung)
+    br.record_failure()  # pre-existing strike the recovery must clear
+    assert be._force_pipelined(_FailingForce(), [["c0"], ["c1"]], {}) is True
+    assert br._failures == 0 and br.state_name == "closed"
+    resilience.reset()
+
+
 # ----------------------------------------------- vectorized pack golden
 
 
@@ -188,6 +249,48 @@ def test_pack_grid_cached_matches_uncached(monkeypatch):
     hits = blsrt.CACHE_EVENTS.value(cache="pubkey_rows", event="hit")
     assert hits >= 6  # the warm pass resolved every real lane from cache
     blsrt.reset_input_caches()
+
+
+def test_pack_grid_oversized_batch_bypasses_cache(monkeypatch):
+    """A batch with more distinct pubkeys than the arena has slots must
+    NOT take the insert-then-gather path: the miss-insert loop's LRU
+    evictions would overwrite slots already recorded for this batch
+    before the gather runs. It builds uncached (bypass events) and the
+    grid stays byte-identical."""
+    from lighthouse_tpu.crypto.bls.curve import g1_infinity
+
+    sets = _mixed_sets()  # 6 lanes, 5 distinct pubkeys
+    S, K, n = 4, 2, 4
+    inf1 = g1_infinity()
+    monkeypatch.setenv("LHTPU_INPUT_CACHE", "0")
+    ref = jb.JaxBackend._pack_pubkey_grid(sets, S, K, n, inf1)
+    monkeypatch.setenv("LHTPU_INPUT_CACHE", "1")
+    monkeypatch.setenv("LHTPU_PUBKEY_CACHE", "2")  # clamp floor < 5 distinct
+    blsrt.reset_input_caches()
+    bypass0 = blsrt.CACHE_EVENTS.value(cache="pubkey_rows", event="bypass")
+    got = jb.JaxBackend._pack_pubkey_grid(sets, S, K, n, inf1)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+    assert (
+        blsrt.CACHE_EVENTS.value(cache="pubkey_rows", event="bypass")
+        - bypass0
+        == 6
+    )
+    assert len(blsrt.PUBKEY_ROW_CACHE) == 0  # nothing was inserted
+    blsrt.reset_input_caches()
+
+
+def test_pubkey_cache_key_canonical():
+    """A key built from a raw point and one built from bytes map to the
+    same canonical cache key — mixed forms never duplicate arena rows."""
+    from lighthouse_tpu.crypto.bls.api import PublicKey
+
+    pk = SKS[0].public_key()
+    raw = pk.to_bytes()
+    from_point = PublicKey(pk.point)  # _bytes starts out None
+    assert from_point._bytes is None
+    assert blsrt.pubkey_cache_key(from_point) == raw
+    assert blsrt.pubkey_cache_key(pk) == raw
 
 
 # ------------------------------------------------- cross-call caches
