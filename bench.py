@@ -127,6 +127,46 @@ def _pipeline_detail() -> dict:
     }
 
 
+def _triage_detail() -> dict:
+    """{"triage": {...}} for JSON lines: whether the last triaged verify
+    used grouped device verdicts, its round/dispatch/group-outcome
+    counts and any fallback route (ISSUE 5)."""
+    report = _stage_report() or {}
+    return {"triage": report.get("triage") or {"enabled": False}}
+
+
+def _forced_sets(backend, sets) -> bool:
+    """Backend warmup/measured verify with the same bounded
+    transient-retry policy as raw device calls (ISSUE 5 satellite: a
+    transient remote-TPU fault inside a bare warmup assert used to
+    crash the whole round with a raw JaxRuntimeError — the BENCH_r05
+    tail)."""
+    from lighthouse_tpu.common import resilience
+
+    return resilience.call_with_retries(
+        lambda: bool(backend.verify_signature_sets(sets)),
+        stage="bench_device",
+    )
+
+
+def _emit_config_fallback(metric: str, config: int, err: Exception) -> None:
+    """Per-config fallback line: one failed BASELINE config must not
+    take down the round (the remaining configs and the headline still
+    emit)."""
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "sets/sec",
+        "vs_baseline": 0.0,
+        "error": f"{type(err).__name__}: {err}"[:400],
+        "detail": {
+            "config": config,
+            "stages": _stage_report(),
+            **_resilience_detail(),
+        },
+    }), flush=True)
+
+
 def _emit_fallback(err: str) -> None:
     """The always-parseable last-resort JSON line (metric matches the
     mode actually being run, so a slot-mode failure doesn't record a
@@ -150,6 +190,7 @@ def _emit_fallback(err: str) -> None:
     }
     line.update(_resilience_detail())
     line.update(_pipeline_detail())
+    line.update(_triage_detail())
     stages = _stage_report()
     if stages is not None:
         line["stages"] = stages
@@ -215,6 +256,7 @@ def slot_chain_mode() -> None:
             "device": jax.devices()[0].platform,
             **_resilience_detail(),
             **_pipeline_detail(),
+            **_triage_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -293,9 +335,9 @@ def slot_mode() -> None:
     assert backend._table_gather_args(sets, len(sets), K) is not None, (
         "indexed path not engaged"
     )
-    ok = backend.verify_signature_sets(sets)  # compile + warm
+    ok = _forced_sets(backend, sets)  # compile + warm (retry-wrapped)
     t0 = time.perf_counter()
-    ok = backend.verify_signature_sets(sets) and ok
+    ok = _forced_sets(backend, sets) and ok
     dt = time.perf_counter() - t0
 
     # Native single-core denominator on a subsample (2 sets with REAL
@@ -323,9 +365,9 @@ def slot_mode() -> None:
                 sub.append(SignatureSet(
                     s.signature, real_pks, s.message
                 ))
-            assert nb.verify_signature_sets(sub)  # warm
+            assert _forced_sets(nb, sub)  # warm
             t0 = time.perf_counter()
-            assert nb.verify_signature_sets(sub)
+            assert _forced_sets(nb, sub)
             native_slot_s = (time.perf_counter() - t0) * (S / nsub)
     except Exception as e:  # record — a native/device DISAGREEMENT must
         native_err = str(e)[:200]  # not masquerade as a missing toolchain
@@ -358,6 +400,7 @@ def slot_mode() -> None:
             "device": jax.devices()[0].platform,
             **_resilience_detail(),
             **_pipeline_detail(),
+            **_triage_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -389,10 +432,10 @@ def pipeline_sweep(backend, sets, reps: int, which: str) -> None:
             from lighthouse_tpu.common import pipeline as _pl
 
             _pl.reset()  # else the off line reports the prior on-run
-            assert backend.verify_signature_sets(sets)  # warm (compiles)
+            assert _forced_sets(backend, sets)  # warm (compiles)
             t0 = time.perf_counter()
             for _ in range(reps):
-                assert backend.verify_signature_sets(sets)
+                assert _forced_sets(backend, sets)
             dt = (time.perf_counter() - t0) / reps
             print(json.dumps({
                 "metric": "bls_pipeline_sweep",
@@ -477,38 +520,44 @@ def configs_mode(backend, nb) -> None:
         return AggregateSignature(hash_to_g2(msg).mul(sk_sum))
 
     # ---- config #1: aggregate_verify, 128 pairs ------------------------
-    n1 = 128
-    msgs1 = [i.to_bytes(32, "big") for i in range(n1)]
-    pks1 = pool[:n1]
-    # aggregate signature = sum_i sk_i * H(m_i); sk_i = i+1
-    acc = None
-    for i, m in enumerate(msgs1):
-        term = hash_to_g2(m).mul(i + 1)
-        acc = term if acc is None else acc.add(term)
-    agg1 = AggregateSignature(acc)
+    def _config1():
+        n1 = 128
+        msgs1 = [i.to_bytes(32, "big") for i in range(n1)]
+        pks1 = pool[:n1]
+        # aggregate signature = sum_i sk_i * H(m_i); sk_i = i+1
+        acc = None
+        for i, m in enumerate(msgs1):
+            term = hash_to_g2(m).mul(i + 1)
+            acc = term if acc is None else acc.add(term)
+        agg1 = AggregateSignature(acc)
 
-    assert _dev_call(lambda: aggregate_verify_device(pks1, msgs1, agg1))  # compile + warm
-    t0 = time.perf_counter()
-    assert _dev_call(lambda: aggregate_verify_device(pks1, msgs1, agg1))
-    dt1 = time.perf_counter() - t0
-    nat1 = None
-    if nb is not None:
-        assert nb.aggregate_verify(pks1, msgs1, agg1)
+        assert _dev_call(lambda: aggregate_verify_device(pks1, msgs1, agg1))  # compile + warm
         t0 = time.perf_counter()
-        assert nb.aggregate_verify(pks1, msgs1, agg1)
-        nat1 = time.perf_counter() - t0
-    print(json.dumps({
-        "metric": "bls_aggregate_verify_pairs_per_sec",
-        "value": round(n1 / dt1, 1),
-        "unit": "pairs/sec",
-        "vs_baseline": round((nat1 / dt1), 3) if nat1 else 0.0,
-        "detail": {
-            "config": 1, "pairs": n1, "device": dev,
-            "device_ms": round(dt1 * 1e3, 1),
-            "native_cpu_ms": round(nat1 * 1e3, 1) if nat1 else None,
-            **_resilience_detail(),
-        },
-    }))
+        assert _dev_call(lambda: aggregate_verify_device(pks1, msgs1, agg1))
+        dt1 = time.perf_counter() - t0
+        nat1 = None
+        if nb is not None:
+            assert nb.aggregate_verify(pks1, msgs1, agg1)
+            t0 = time.perf_counter()
+            assert nb.aggregate_verify(pks1, msgs1, agg1)
+            nat1 = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "bls_aggregate_verify_pairs_per_sec",
+            "value": round(n1 / dt1, 1),
+            "unit": "pairs/sec",
+            "vs_baseline": round((nat1 / dt1), 3) if nat1 else 0.0,
+            "detail": {
+                "config": 1, "pairs": n1, "device": dev,
+                "device_ms": round(dt1 * 1e3, 1),
+                "native_cpu_ms": round(nat1 * 1e3, 1) if nat1 else None,
+                **_resilience_detail(),
+            },
+        }))
+
+    try:
+        _config1()
+    except Exception as e:
+        _emit_config_fallback("bls_aggregate_verify_pairs_per_sec", 1, e)
 
     # ---- config #2: mainnet-block signature batch ----------------------
     # ~128 attestation sets with mixed committee sizes + proposal/randao/
@@ -529,71 +578,83 @@ def configs_mode(backend, nb) -> None:
             agg_sig_for([j], msg), [pool[j]], msg
         ))
 
-    assert backend.verify_signature_sets(sets2)  # compile + warm
-    t0 = time.perf_counter()
-    assert backend.verify_signature_sets(sets2)
-    dt2 = time.perf_counter() - t0
-    nat2 = None
-    if nb is not None:
-        assert nb.verify_signature_sets(sets2)
+    def _config2():
+        assert _forced_sets(backend, sets2)  # compile + warm
         t0 = time.perf_counter()
-        assert nb.verify_signature_sets(sets2)
-        nat2 = time.perf_counter() - t0
-    print(json.dumps({
-        "metric": "block_batch_sets_per_sec",
-        "value": round(len(sets2) / dt2, 1),
-        "unit": "sets/sec",
-        "vs_baseline": round(nat2 / dt2, 3) if nat2 else 0.0,
-        "detail": {
-            "config": 2, "sets": len(sets2),
-            "attester_sigs": sum(len(s.signing_keys) for s in sets2),
-            "device": dev, "device_ms": round(dt2 * 1e3, 1),
-            "native_cpu_ms": round(nat2 * 1e3, 1) if nat2 else None,
-            **_resilience_detail(),
-        },
-    }))
+        assert _forced_sets(backend, sets2)
+        dt2 = time.perf_counter() - t0
+        nat2 = None
+        if nb is not None:
+            assert _forced_sets(nb, sets2)
+            t0 = time.perf_counter()
+            assert _forced_sets(nb, sets2)
+            nat2 = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "block_batch_sets_per_sec",
+            "value": round(len(sets2) / dt2, 1),
+            "unit": "sets/sec",
+            "vs_baseline": round(nat2 / dt2, 3) if nat2 else 0.0,
+            "detail": {
+                "config": 2, "sets": len(sets2),
+                "attester_sigs": sum(len(s.signing_keys) for s in sets2),
+                "device": dev, "device_ms": round(dt2 * 1e3, 1),
+                "native_cpu_ms": round(nat2 * 1e3, 1) if nat2 else None,
+                **_resilience_detail(),
+            },
+        }))
+
+    try:
+        _config2()
+    except Exception as e:
+        _emit_config_fallback("block_batch_sets_per_sec", 2, e)
 
     # ---- config #3: 512-key fast_aggregate_verify ----------------------
-    msg3 = (30_000).to_bytes(32, "big")
-    idxs3 = list(range(512))
-    set3 = SignatureSet.multiple_pubkeys(
-        agg_sig_for(idxs3, msg3), [pool[i] for i in idxs3], msg3
-    )
-    assert backend.verify_signature_sets([set3])  # warm (may route host)
-    t0 = time.perf_counter()
-    assert backend.verify_signature_sets([set3])
-    dt3 = time.perf_counter() - t0
-    path3 = backend.last_path
-    # raw device path for the record (production routes tiny batches to
-    # the native host fallback — jax_backend._dispatch cost model)
-    os.environ["LHTPU_HOST_FALLBACK"] = "0"
+    def _config3():
+        msg3 = (30_000).to_bytes(32, "big")
+        idxs3 = list(range(512))
+        set3 = SignatureSet.multiple_pubkeys(
+            agg_sig_for(idxs3, msg3), [pool[i] for i in idxs3], msg3
+        )
+        assert _forced_sets(backend, [set3])  # warm (may route host)
+        t0 = time.perf_counter()
+        assert _forced_sets(backend, [set3])
+        dt3 = time.perf_counter() - t0
+        path3 = backend.last_path
+        # raw device path for the record (production routes tiny batches to
+        # the native host fallback — jax_backend._dispatch cost model)
+        os.environ["LHTPU_HOST_FALLBACK"] = "0"
+        try:
+            assert _forced_sets(backend, [set3])  # compile + warm
+            t0 = time.perf_counter()
+            assert _forced_sets(backend, [set3])
+            dev3 = time.perf_counter() - t0
+        finally:
+            del os.environ["LHTPU_HOST_FALLBACK"]
+        nat3 = None
+        if nb is not None:
+            assert _forced_sets(nb, [set3])
+            t0 = time.perf_counter()
+            assert _forced_sets(nb, [set3])
+            nat3 = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "fast_aggregate_verify_512_per_sec",
+            "value": round(1 / dt3, 2),
+            "unit": "verifications/sec",
+            "vs_baseline": round(nat3 / dt3, 3) if nat3 else 0.0,
+            "detail": {
+                "config": 3, "keys": 512, "device": dev,
+                "path": path3,
+                "routed_ms": round(dt3 * 1e3, 1),
+                "device_forced_ms": round(dev3 * 1e3, 1),
+                "native_cpu_ms": round(nat3 * 1e3, 1) if nat3 else None,
+                "retries": _resilience_detail()["retries"],
+            },
+        }))
+
     try:
-        assert backend.verify_signature_sets([set3])  # compile + warm
-        t0 = time.perf_counter()
-        assert backend.verify_signature_sets([set3])
-        dev3 = time.perf_counter() - t0
-    finally:
-        del os.environ["LHTPU_HOST_FALLBACK"]
-    nat3 = None
-    if nb is not None:
-        assert nb.verify_signature_sets([set3])
-        t0 = time.perf_counter()
-        assert nb.verify_signature_sets([set3])
-        nat3 = time.perf_counter() - t0
-    print(json.dumps({
-        "metric": "fast_aggregate_verify_512_per_sec",
-        "value": round(1 / dt3, 2),
-        "unit": "verifications/sec",
-        "vs_baseline": round(nat3 / dt3, 3) if nat3 else 0.0,
-        "detail": {
-            "config": 3, "keys": 512, "device": dev,
-            "path": path3,
-            "routed_ms": round(dt3 * 1e3, 1),
-            "device_forced_ms": round(dev3 * 1e3, 1),
-            "native_cpu_ms": round(nat3 * 1e3, 1) if nat3 else None,
-            "retries": _resilience_detail()["retries"],
-        },
-    }))
+        _config3()
+    except Exception as e:
+        _emit_config_fallback("fast_aggregate_verify_512_per_sec", 3, e)
 
 
 def main() -> None:
@@ -710,9 +771,9 @@ def main() -> None:
     dev_rate = S / dev_dt
 
     # --- timed: end-to-end through the backend ------------------------------
-    assert backend.verify_signature_sets(sets)  # compile/warm the htc path
+    assert _forced_sets(backend, sets)  # compile/warm the htc path
     t0 = time.perf_counter()
-    assert backend.verify_signature_sets(sets)
+    assert _forced_sets(backend, sets)
     e2e_sync_dt = time.perf_counter() - t0
 
     # Steady-state pipelined e2e (the headline): async dispatch lets the
@@ -759,9 +820,9 @@ def main() -> None:
         nb = load_native_backend()
         if nb is not None:
             sub = sets[:BASELINE_SETS]
-            assert nb.verify_signature_sets(sub)  # warm
+            assert _forced_sets(nb, sub)  # warm
             t0 = time.perf_counter()
-            assert nb.verify_signature_sets(sub)
+            assert _forced_sets(nb, sub)
             native_dt = time.perf_counter() - t0
             native_rate = len(sub) / native_dt
             detail["native_cpu_sets_per_sec"] = round(native_rate, 3)
@@ -793,6 +854,7 @@ def main() -> None:
     # batch actually took: a bench that survived a transient must SAY so.
     detail.update(_resilience_detail())
     detail.update(headline_pipeline)
+    detail.update(_triage_detail())
     detail["path"] = headline_path
 
     base = native_rate if native_rate else detail["cpu_python_sets_per_sec"]

@@ -322,19 +322,108 @@ class DevicePubkeyTable:
         return idx, inf
 
 
+# Jitted shift-add step for the incremental sequential-table build:
+# chunk i's affine rows + the constant point [chunk]G, one batched
+# complete mixed add + one batched to-affine. Module-cached so every
+# build (and the golden test) reuses one compiled program per chunk
+# shape.
+_SEQ_STEP_FN = None
+
+
+def _seq_table_step_fn():
+    global _SEQ_STEP_FN
+    if _SEQ_STEP_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        from .ops import tkernel as tk
+        from .ops.points import FP_OPS, pt_add_mixed, pt_from_affine
+        from .ops.tkernel_calls import to_affine_g1_t
+
+        def step(ax, ay, shx, shy):
+            T = ax.shape[0]
+            inf = jnp.zeros((T,), bool)
+            P = pt_from_affine(FP_OPS, ax, ay, inf)
+            Q = (
+                jnp.broadcast_to(shx[None, :], (T, 48)),
+                jnp.broadcast_to(shy[None, :], (T, 48)),
+            )
+            R = pt_add_mixed(FP_OPS, P, Q, inf)
+            R_t = tuple(tk.batch_to_t(c) for c in R)
+            return to_affine_g1_t(R_t)
+
+        _SEQ_STEP_FN = jax.jit(step)
+    return _SEQ_STEP_FN
+
+
 def build_sequential_table(n: int, chunk: int = 8192) -> DevicePubkeyTable:
     """Fixture/scale-demo table: pk_i = (i+1)*G for i < n, built ON
-    DEVICE — per chunk one batched scalar-mul kernel (~21-step chains,
-    scalars are lane indices) and one batched to-affine kernel, then a
-    uint8 download into the host staging planes. Replaces round 2's
-    sequential host loop (1M Python point-adds = hours; VERDICT r2
-    item 5); 1M keys build in minutes on a v5e. Production tables are
-    built by append_pubkeys from real deserialized keys — this exists so
-    BASELINE config #5 can exercise registry scale honestly.
+    DEVICE and INCREMENTALLY (ISSUE 5 satellite): chunk 0 runs one
+    batched double-and-add scalar-mul (bit_length(chunk) steps — the
+    scalars are 1..chunk, not 1..n), and every later chunk is chunk i-1
+    plus the constant point [chunk]G via ONE batched mixed point-add —
+    replacing the per-chunk ~bit_length(n)-step ladder that made the
+    1M-key build cost 119.4 s of table_build_s in BENCH_SLOT_r03.json
+    (~20 ladder steps ≈ 40 group ops per chunk, vs 1 here). Bitwise
+    equal to the old all-scalar-mul builder
+    (:func:`_build_sequential_table_scalarmul`, kept as the golden
+    reference); affine downloads stay the canonical representation.
+    Production tables are built by append_pubkeys from real deserialized
+    keys — this exists so BASELINE config #5 can exercise registry scale
+    honestly.
     """
     import jax.numpy as jnp
 
+    from .crypto.bls.curve import g1_generator
     from .ops import tkernel as tk
+    from .ops.points import G1_GEN_DEV, g1_to_dev
+    from .ops.tkernel_calls import scalar_mul_g1_t, to_affine_g1_t
+
+    table = DevicePubkeyTable()
+    table._cap = max(DevicePubkeyTable.MIN_CAPACITY, next_pow2(n))
+    table._host_x = np.zeros((table._cap, 48), np.uint8)
+    table._host_y = np.zeros((table._cap, 48), np.uint8)
+
+    # Chunk 0: scalars 1..chunk through the scalar-mul ladder (the only
+    # chunk that needs one).
+    nbits = max(1, int(min(n, chunk)).bit_length())
+    gx = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[0])[:, None], (48, chunk))
+    gy = jnp.broadcast_to(jnp.asarray(G1_GEN_DEV[1])[:, None], (48, chunk))
+    inf_row = jnp.zeros((1, chunk), jnp.int32)
+    scalars = np.arange(1, chunk + 1, dtype=np.uint64)
+    shifts = np.arange(nbits - 1, -1, -1, dtype=np.uint64)
+    bits = ((scalars[None, :] >> shifts[:, None]) & 1).astype(np.int32)
+    P = scalar_mul_g1_t(gx, gy, inf_row, jnp.asarray(bits))
+    ax_t, ay_t, ainf = to_affine_g1_t(P)
+
+    # The constant stride point [chunk]G (host oracle scalar-mul, once).
+    shx, shy, shinf = g1_to_dev([g1_generator().mul(chunk)])
+    shx_d, shy_d = jnp.asarray(shx[0]), jnp.asarray(shy[0])
+    step = _seq_table_step_fn()
+
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        assert not bool(np.asarray(ainf)[: hi - lo].any())
+        # transposed [48, chunk] -> rows [chunk, 48]
+        table._host_x[lo:hi] = np.asarray(ax_t).T[: hi - lo].astype(np.uint8)
+        table._host_y[lo:hi] = np.asarray(ay_t).T[: hi - lo].astype(np.uint8)
+        if hi < n:
+            # Next chunk = this chunk + [chunk]G, one batched mixed add.
+            ax_c = tk.batch_from_t(ax_t)
+            ay_c = tk.batch_from_t(ay_t)
+            ax_t, ay_t, ainf = step(ax_c, ay_c, shx_d, shy_d)
+    table._n = n
+    table._dirty = True
+    return table
+
+
+def _build_sequential_table_scalarmul(n: int,
+                                      chunk: int = 8192) -> DevicePubkeyTable:
+    """The pre-ISSUE-5 builder — every chunk runs the full
+    bit_length(n)-step scalar-mul ladder from G. Kept as the golden
+    reference for build_sequential_table's equality test."""
+    import jax.numpy as jnp
+
     from .ops.points import G1_GEN_DEV
     from .ops.tkernel_calls import scalar_mul_g1_t, to_affine_g1_t
 

@@ -82,12 +82,24 @@ def split(sets: list) -> list:
     return [sets[i:i + step] for i in range(0, len(sets), step)]
 
 
+def triage_chunks(n: int) -> list:
+    """(offset, length) microbatch spans for an n-set triaged verify.
+
+    The triage path (ISSUE 5) keeps its own packed-grid handles per
+    chunk, so it chunks by span rather than by slicing the set list —
+    same sizing policy as :func:`split`.
+    """
+    step = chunk_size(n)
+    return [(i, min(step, n - i)) for i in range(0, n, step)]
+
+
 class PipelineRun:
     """Per-call accumulator for chunk counts and overlap seconds."""
 
-    def __init__(self, total_sets: int, n_chunks: int):
+    def __init__(self, total_sets: int, n_chunks: int, mode: str = "verify"):
         self.total_sets = total_sets
         self.n_chunks = n_chunks
+        self.mode = mode
         self.chunks_done = 0
         self.host_exposed_s = 0.0
         self.host_hidden_s = 0.0
@@ -130,6 +142,7 @@ class PipelineRun:
         }
         report = {
             "enabled": True,
+            "mode": self.mode,
             "total_sets": self.total_sets,
             "chunks": self.chunks_done,
             "chunk_size": chunk_size(self.total_sets),

@@ -312,6 +312,68 @@ def verify_signature_sets(sets: list[SignatureSet], backend: str | None = None) 
     return get_backend(backend).verify_signature_sets(sets)
 
 
+# Poison-triage fallback knobs (ISSUE 5): the host-side bisection that
+# verify_signature_sets_triaged degrades to when no grouped device path
+# is available. Values match the chain layer's historical policy
+# (BeaconChain used these constants before the rewire).
+BISECT_LINEAR_CUTOFF = 2
+BISECT_WORK_BUDGET = 6
+
+
+def bisect_verify_sets(sets: list[SignatureSet],
+                       backend: str | None = None,
+                       budget: list[int] | None = None) -> list[bool]:
+    """Per-set verdicts by budgeted halving bisection over
+    :func:`verify_signature_sets`.
+
+    The pre-ISSUE-5 recovery strategy, hoisted out of
+    chain/beacon_chain.py so both the chain layer and the backend's
+    degraded-triage route share one implementation: batch passes ->
+    everything valid in one call; otherwise split and recurse, each
+    level re-entering the batch entry point (re-pack and re-hash
+    included — that cost is exactly what device triage avoids). The
+    work budget (in set-verifications) bounds adversarial recursion;
+    once spent, remaining spans verify one set at a time.
+    """
+    if not sets:
+        return []
+    if budget is None:
+        budget = [BISECT_WORK_BUDGET * len(sets)]
+    budget[0] -= len(sets)
+    if verify_signature_sets(sets, backend=backend):
+        return [True] * len(sets)
+    if len(sets) == 1:
+        return [False]
+    if len(sets) <= BISECT_LINEAR_CUTOFF or budget[0] <= 0:
+        return [
+            verify_signature_sets([s], backend=backend) for s in sets
+        ]
+    mid = len(sets) // 2
+    return (
+        bisect_verify_sets(sets[:mid], backend, budget)
+        + bisect_verify_sets(sets[mid:], backend, budget)
+    )
+
+
+def verify_signature_sets_triaged(sets: list[SignatureSet],
+                                  backend: str | None = None) -> list[bool]:
+    """Per-set verdicts at amortized batch cost (ISSUE 5).
+
+    Backends that implement grouped device verdicts (jax) resolve a
+    poisoned batch in O(log_G poisoned-groups) dispatches without
+    re-packing; any other backend degrades to the budgeted host
+    bisection above. Verdicts are bit-identical to verifying each set
+    alone on either route.
+    """
+    from .backends import get_backend
+
+    be = get_backend(backend)
+    fn = getattr(be, "verify_signature_sets_triaged", None)
+    if fn is not None:
+        return fn(sets)
+    return bisect_verify_sets(sets, backend=backend)
+
+
 def verify_signature_sets_python(sets: list[SignatureSet]) -> bool:
     """Pure-Python RLC batch verification (oracle / fallback path)."""
     if not sets:

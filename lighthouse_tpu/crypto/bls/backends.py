@@ -17,6 +17,13 @@ from typing import Protocol
 class Backend(Protocol):
     def verify_signature_sets(self, sets) -> bool: ...
 
+    # Optional capability (ISSUE 5): per-set verdicts at amortized batch
+    # cost. Backends with grouped device verdicts (jax) implement
+    # ``verify_signature_sets_triaged(sets) -> list[bool]``; callers go
+    # through api.verify_signature_sets_triaged, which degrades to
+    # budgeted host bisection when the attribute is absent — so the
+    # Protocol deliberately does NOT require it.
+
 
 class PythonBackend:
     name = "python"

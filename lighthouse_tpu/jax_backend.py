@@ -65,7 +65,7 @@ from .ops.points import (
     pt_tree_sum,
     pt_tree_sum_axis,
 )
-from .ops.pairing import fp12_tree_prod
+from .ops.pairing import fp12_tree_prod, fp12_tree_prod_groups
 from .ops.tower import fp12_is_one, fp12_mul
 
 
@@ -114,6 +114,15 @@ NATIVE_LOAD_FAILURES = REGISTRY.counter(
     "native_backend_load_failures_total",
     "Native C++ BLS backend load attempts that found no usable library",
 )
+TRIAGE_DISPATCHES = REGISTRY.counter(
+    "bls_triage_dispatches_total",
+    "Grouped-verdict device dispatches issued by poison triage",
+)
+TRIAGE_GROUPS = REGISTRY.counter(
+    "bls_triage_groups",
+    "Verdict groups inspected by poison triage, by outcome",
+    ("outcome",),
+)
 
 _LOG = StructuredLogger("jax_backend")
 
@@ -132,6 +141,27 @@ HOST_FALLBACK_MS_PER_KEY = 0.05
 _LAST_STAGES: dict[str, float] = {}
 _LAST_ERROR_STAGE: str | None = None
 _LAST_PATH: str | None = None
+# Most recent verify_signature_sets_triaged accounting (rounds /
+# dispatches / group outcomes / fallback route), mirrored into
+# dispatch_stage_report()["triage"] and bench detail.triage.
+_LAST_TRIAGE: dict = {"enabled": False}
+
+
+def _verdict_groups() -> int:
+    """Target group count G for grouped-verdict dispatches
+    (LHTPU_VERDICT_GROUPS; 0 disables device triage). Default 32: per
+    the stage histograms the marginal cost of G verdicts — G-1 extra
+    check-pair Miller lanes and a [G]-batched final exponentiation —
+    stays under ~5% of the Miller work there. Rounded up to a power of
+    two so G always divides the padded set count."""
+    raw = os.environ.get("LHTPU_VERDICT_GROUPS", "32")
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 32
+    if v <= 0:
+        return 0
+    return _next_pow2(max(2, v))
 
 
 @contextmanager
@@ -230,6 +260,7 @@ def dispatch_stage_report() -> dict:
         "path": _LAST_PATH,
         "pipeline": pipeline.last_run_report(),
         "cache": _input_cache_report(),
+        "triage": dict(_LAST_TRIAGE),
     }
 
 
@@ -374,6 +405,69 @@ def _verify_core(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
 
 
 _verify_jit = jax.jit(_verify_core)
+
+
+def _verify_core_grouped(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
+                         n_groups: int):
+    """Grouped-verdict variant of :func:`_verify_core` (ISSUE 5).
+
+    The S padded sets split into ``n_groups`` contiguous groups of
+    S // n_groups lanes; each group gets its own RLC signature
+    accumulator, its own check pair e(-g1, sig_acc_g) and its own Fp12
+    Miller-product fold, so ONE dispatch returns bool[n_groups] instead
+    of an AND-collapsed scalar — a poisoned batch names its guilty
+    group(s) for free. Marginal cost over _verify_core: n_groups - 1
+    extra check-pair Miller lanes plus an [n_groups]-batched final
+    exponentiation. All-padding groups read True (every lane at
+    infinity contributes Fp12 one). With group size 1 each verdict is
+    the EXACT per-set pairing check (the nonzero blinding scalar
+    cancels: x^r = 1 <=> x = 1 in the prime-order target group).
+    """
+    S, K = pk_inf.shape
+    G = n_groups
+    gs = S // G
+    assert G * gs == S, "group count must divide the padded set count"
+
+    pk_j = pt_from_affine(FP_OPS, pk[0], pk[1], pk_inf)
+    agg = pt_tree_sum_axis(FP_OPS, pk_j, axis=1, axis_size=K)  # [S]
+    agg_aff = pt_to_affine(FP_OPS, agg)
+
+    rpk = pt_scalar_mul_bits(FP_OPS, agg_aff[:2], agg_aff[2], r_bits)
+    rsig = pt_scalar_mul_bits(FP2_OPS, sig, sig_inf, r_bits)
+
+    sig_j = pt_from_affine(FP2_OPS, sig[0], sig[1], sig_inf)
+    sub_ok = jnp.all(
+        pt_subgroup_check(FP2_OPS, sig_j).reshape(G, gs), axis=1
+    )  # [G]
+
+    # Per-group RLC signature accumulators: one batched halving tree.
+    rsig_g = tuple(c.reshape(G, gs, *c.shape[1:]) for c in rsig)
+    sig_acc = pt_tree_sum_axis(FP2_OPS, rsig_g, axis=1, axis_size=gs)
+    sig_acc_aff = pt_to_affine(FP2_OPS, sig_acc)  # [G]
+
+    # S set pairs + G check pairs in ONE Miller batch.
+    rpk_aff = pt_to_affine(FP_OPS, rpk)
+    g1_x = jnp.concatenate(
+        [rpk_aff[0], jnp.broadcast_to(G1_GEN_DEV[0][None], (G, 48))]
+    )
+    g1_y = jnp.concatenate(
+        [rpk_aff[1], jnp.broadcast_to(limb.neg(G1_GEN_DEV[1])[None], (G, 48))]
+    )
+    g1_inf = jnp.concatenate([rpk_aff[2], jnp.zeros((G,), bool)])
+    g2_x = jnp.concatenate([msg[0], sig_acc_aff[0]])
+    g2_y = jnp.concatenate([msg[1], sig_acc_aff[1]])
+    g2_inf = jnp.concatenate([msg_inf, sig_acc_aff[2]])
+
+    f = miller_loop((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf)
+    f_grp = fp12_tree_prod_groups(f[:S].reshape(G, gs, *f.shape[1:]), gs)
+    f_grp = fp12_mul(f_grp, f[S:])      # fold in the check pairs, [G]
+    fe = final_exponentiation(f_grp)    # batched over the group axis
+    return fp12_is_one(fe) & sub_ok    # bool[G]
+
+
+_verify_grouped_jit = jax.jit(
+    _verify_core_grouped, static_argnames=("n_groups",)
+)
 
 
 # --- mesh collective building blocks of the fused path -------------------
@@ -537,6 +631,96 @@ def _verify_core_fused(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
 _verify_fused_jit = jax.jit(_verify_core_fused)
 
 
+def _verify_core_fused_grouped(pk, pk_inf, sig, sig_inf, msg, msg_inf,
+                               r_bits, n_groups: int, *,
+                               axis: str | None = None):
+    """Fused-kernel twin of :func:`_verify_core_grouped` (same grouped
+    contract, Pallas kernel bodies — bit-equivalent verdict vector).
+
+    Takes no MSM schedule: the bucketed MSM kernel yields ONE global
+    signature accumulator, while grouped verdicts need one accumulator
+    per group — the per-lane scalar-mul scan plus a batched per-group
+    halving tree is the natural formulation, and its cost was already
+    acceptable pre-MSM.
+
+    ``axis``: under shard_map with S sharded over that mesh axis, groups
+    stay chip-local (the caller guarantees n_groups divides the same
+    way), so the ONLY collective is an all_gather of the per-chip
+    verdict lanes — no cross-chip point or Fp12 folds at all.
+    """
+    from .ops import tkernel as tk
+    from .ops import tkernel_calls as tc
+
+    S, K = pk_inf.shape
+    G = n_groups
+    gs = S // G
+    assert G * gs == S, "group count must divide the padded set count"
+
+    def mask_row(m):
+        return m[None, :].astype(jnp.int32)
+
+    pk_j = pt_from_affine(FP_OPS, pk[0], pk[1], pk_inf)
+    agg = pt_tree_sum_axis(FP_OPS, pk_j, axis=1, axis_size=K)  # [S]
+    agg_t = tuple(tk.batch_to_t(c) for c in agg)
+    ax, ay, ainf = tc.to_affine_g1_t(agg_t)
+
+    bits_t = jnp.transpose(r_bits)                       # [64, S]
+    sig_t = (tk.batch_to_t(sig[0]), tk.batch_to_t(sig[1]))
+    rpk = tc.scalar_mul_g1_t(ax, ay, mask_row(ainf), bits_t)
+    rsig = tc.scalar_mul_g2_t(sig_t[0], sig_t[1], mask_row(sig_inf), bits_t)
+
+    ok_lanes = tc.subgroup_check_g2_fast_t(
+        sig_t[0], sig_t[1], mask_row(sig_inf)
+    )
+    sub_ok = jnp.all(ok_lanes.reshape(G, gs), axis=1)    # [G], chip-local
+
+    # Per-group RLC signature accumulators + one affine kernel over G.
+    rsig_c = tuple(tk.batch_from_t(c) for c in rsig)
+    rsig_g = tuple(c.reshape(G, gs, *c.shape[1:]) for c in rsig_c)
+    sig_acc = pt_tree_sum_axis(FP2_OPS, rsig_g, axis=1, axis_size=gs)
+    sig_acc_t = tuple(tk.batch_to_t(c) for c in sig_acc)
+    sax, say, sainf = tc.to_affine_g2_t(sig_acc_t)
+
+    rx, ry, rinf = tc.to_affine_g1_t(rpk)
+
+    # S set pairs + G check pairs through one Miller kernel.
+    neg_g1 = (G1_GEN_DEV[0][:, None], limb.neg(G1_GEN_DEV[1])[:, None])
+    g1_x = jnp.concatenate(
+        [rx, jnp.broadcast_to(neg_g1[0], (48, G))], axis=-1
+    )
+    g1_y = jnp.concatenate(
+        [ry, jnp.broadcast_to(neg_g1[1], (48, G))], axis=-1
+    )
+    g1_inf = jnp.concatenate([rinf, jnp.zeros((G,), bool)])
+    msg_t = (tk.batch_to_t(msg[0]), tk.batch_to_t(msg[1]))
+    g2_x = jnp.concatenate([msg_t[0], sax], axis=-1)
+    g2_y = jnp.concatenate([msg_t[1], say], axis=-1)
+    g2_inf = jnp.concatenate([msg_inf, sainf])
+
+    f = tc.miller_loop_kernel_t((g1_x, g1_y), g1_inf, (g2_x, g2_y), g2_inf)
+    f_c = tk.batch_from_t(f)                              # [S+G, ...]
+    f_grp = fp12_tree_prod_groups(
+        f_c[:S].reshape(G, gs, *f_c.shape[1:]), gs
+    )
+    f_grp = fp12_mul(f_grp, f_c[S:])
+
+    # Final exponentiation: the kernel is already lane-batched, so the
+    # G group lanes ride one program (tools/profile_stages.py --json
+    # reports the G-lane vs 1-lane overhead for group-count tuning).
+    fe = tc.final_exp_kernel_t(tk.batch_to_t(f_grp))
+    ok = tower.fp12_is_one(tk.batch_from_t(fe)) & sub_ok  # [G]
+    if axis is not None:
+        # Chips hold contiguous S (hence group) slices: gathering the
+        # per-chip verdict lanes in axis order IS the global vector.
+        ok = jax.lax.all_gather(ok, axis).reshape(-1)
+    return ok
+
+
+_verify_fused_grouped_jit = jax.jit(
+    _verify_core_fused_grouped, static_argnames=("n_groups",)
+)
+
+
 def _aggregate_verify_core_fused(pkx, pky, pkinf, mx, my, minf,
                                  sigx, sigy, siginf):
     """Device AggregateVerify: prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1.
@@ -684,9 +868,55 @@ def _gathered(fn):
 _verify_indexed_jit = jax.jit(_gathered(_verify_core))
 _verify_fused_indexed_jit = jax.jit(_gathered(_verify_core_fused))
 
+
+def _gathered_grouped(fn):
+    """HBM-table wrapper for the grouped cores (no MSM leg — see
+    _verify_core_fused_grouped)."""
+
+    def wrapped(tx, ty, idx, pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
+                n_groups):
+        px = tx[idx].astype(jnp.int32)
+        py = ty[idx].astype(jnp.int32)
+        return fn((px, py), pk_inf, sig, sig_inf, msg, msg_inf, r_bits,
+                  n_groups=n_groups)
+
+    return wrapped
+
+
+_verify_indexed_grouped_jit = jax.jit(
+    _gathered_grouped(_verify_core_grouped), static_argnames=("n_groups",)
+)
+_verify_fused_indexed_grouped_jit = jax.jit(
+    _gathered_grouped(_verify_core_fused_grouped),
+    static_argnames=("n_groups",),
+)
+
 # Sharded fused programs keyed by (device count, indexed): built lazily
 # when more than one chip is visible.
 _SHARDED_FUSED: dict = {}
+# Sharded grouped-verdict programs keyed by (device count, group count,
+# indexed) — triage's multichip route.
+_SHARDED_GROUPED: dict = {}
+
+
+def _sharded_fused_grouped_fn(n_dev: int, n_groups: int,
+                              indexed: bool = False):
+    key = (n_dev, n_groups, indexed)
+    if key not in _SHARDED_GROUPED:
+        from .parallel import (
+            build_sharded_fused_grouped_indexed_verifier,
+            build_sharded_fused_grouped_verifier,
+            make_mesh,
+        )
+
+        mesh = make_mesh(n_dev, mp=1)
+        build = (
+            build_sharded_fused_grouped_indexed_verifier
+            if indexed
+            else build_sharded_fused_grouped_verifier
+        )
+        _SHARDED_GROUPED[key] = jax.jit(build(mesh, n_groups))
+    return _SHARDED_GROUPED[key]
 
 
 def _sharded_fused_fn(n_dev: int, indexed: bool = False,
@@ -727,6 +957,125 @@ def _rand_scalars(n: int) -> tuple[np.ndarray, np.ndarray]:
 def _rand_bits_array(n: int) -> np.ndarray:
     """Bit tensor only (kept for tests/benches that don't need the MSM)."""
     return _rand_scalars(n)[1]
+
+
+class _TriagePack:
+    """Padded per-row device inputs for one triage chunk, retained on
+    the host so refinement rounds re-dispatch by ROW SLICING — no
+    re-pack, no re-hash, no re-limbify (the pack and hash_to_curve
+    stages dominate bisection re-dispatch cost per
+    bls_dispatch_stage_seconds).
+
+    Pubkeys are either table-indexed (tx/ty HBM planes shared by
+    reference, idx/pinf host grids) or materialized limb grids
+    (px/py/pinf; K=1 when the host-aggregation path collapsed the
+    grid). Hash outputs may be live device arrays on the device-HTC
+    path — _rows_take slices those with jnp so they never sync."""
+
+    __slots__ = ("n", "S", "K", "tx", "ty", "idx", "px", "py", "pinf",
+                 "sx", "sy", "sinf", "mx", "my", "minf", "r_bits")
+
+    def __init__(self, n: int, S: int, K: int):
+        self.n, self.S, self.K = n, S, K
+        self.tx = self.ty = self.idx = None
+        self.px = self.py = None
+
+
+def _rows_take(arr, sel, pad: int, fill):
+    """Row-select + tail-pad for numpy or jax arrays (device arrays
+    stay on device)."""
+    if isinstance(arr, np.ndarray):
+        out = arr[np.asarray(sel, np.int64)]
+        if pad:
+            out = np.concatenate(
+                [out, np.full((pad, *out.shape[1:]), fill, out.dtype)]
+            )
+        return out
+    out = jnp.take(arr, jnp.asarray(np.asarray(sel, np.int64)), axis=0)
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.full((pad, *out.shape[1:]), fill, out.dtype)]
+        )
+    return out
+
+
+def _concat_pad(parts, pad: int, fill):
+    """Concatenate row blocks (numpy or jax) and tail-pad ``pad`` rows
+    of ``fill``."""
+    xp = np if isinstance(parts[0], np.ndarray) else jnp
+    out = parts[0] if len(parts) == 1 else xp.concatenate(parts)
+    if pad:
+        out = xp.concatenate(
+            [out, xp.full((pad, *out.shape[1:]), fill, out.dtype)]
+        )
+    return out
+
+
+def _widen_keys(rows, K_to: int, fill):
+    """Pad a [rows, K, ...] grid block's key axis to ``K_to`` lanes
+    (chunks pad K independently; refinement concatenates across
+    chunks)."""
+    K = rows.shape[1]
+    if K == K_to:
+        return rows
+    xp = np if isinstance(rows, np.ndarray) else jnp
+    pad = xp.full(
+        (rows.shape[0], K_to - K, *rows.shape[2:]), fill, rows.dtype
+    )
+    return xp.concatenate([rows, pad], axis=1)
+
+
+def _slice_packs(packs, sel):
+    """Assemble a refinement _TriagePack by slicing rows out of the
+    round-1 packs: ``packs`` is [(offset, pack)] covering the live
+    batch in order, ``sel`` sorted global set indices. Returns None
+    when chunks disagree on pubkey mode (table vs grid, or different
+    table objects — possible only if the device table was swapped
+    between chunk packs); the caller degrades those sets to host
+    bisection."""
+    first = packs[0][1]
+    table_mode = first.tx is not None
+    for _, p in packs:
+        if (p.tx is not None) != table_mode:
+            return None
+        if table_mode and (p.tx is not first.tx or p.ty is not first.ty):
+            return None
+
+    m = len(sel)
+    S2 = _next_pow2(m)
+    pad = S2 - m
+    K2 = max(p.K for _, p in packs)
+    out = _TriagePack(n=m, S=S2, K=K2)
+    sel = np.asarray(sel, np.int64)
+
+    def rows_of(field, fill, per_key: bool):
+        parts = []
+        for off, p in packs:
+            local = sel[(sel >= off) & (sel < off + p.n)] - off
+            if len(local) == 0:
+                continue
+            block = _rows_take(getattr(p, field), local, 0, fill)
+            if per_key:
+                block = _widen_keys(block, K2, fill)
+            parts.append(block)
+        return _concat_pad(parts, pad, fill)
+
+    if table_mode:
+        out.tx, out.ty = first.tx, first.ty
+        out.idx = rows_of("idx", 0, per_key=True)
+        out.pinf = rows_of("pinf", True, per_key=True)
+    else:
+        out.px = rows_of("px", 0, per_key=True)
+        out.py = rows_of("py", 0, per_key=True)
+        out.pinf = rows_of("pinf", True, per_key=True)
+    out.sx = rows_of("sx", 0, per_key=False)
+    out.sy = rows_of("sy", 0, per_key=False)
+    out.sinf = rows_of("sinf", True, per_key=False)
+    out.mx = rows_of("mx", 0, per_key=False)
+    out.my = rows_of("my", 0, per_key=False)
+    out.minf = rows_of("minf", True, per_key=False)
+    out.r_bits = rows_of("r_bits", 0, per_key=False)
+    return out
 
 
 class JaxBackend:
@@ -1125,6 +1474,381 @@ class JaxBackend:
         _LAST_PATH = self.last_path
         DISPATCH_BATCHES.inc(path=self.last_path)
         return verdict
+
+    # ------------------------------------------------ poison triage
+    # ISSUE 5 tentpole: per-set verdicts at amortized batch cost. One
+    # grouped dispatch names the guilty group(s); refinement rounds
+    # re-dispatch ONLY the poisoned groups by slicing the already-packed
+    # limb grids / table indices / hash-to-curve outputs — zero pack,
+    # hash_to_curve or scalars stage time after round 1 (those stages
+    # dominate the old host bisection's re-dispatch cost per
+    # bls_dispatch_stage_seconds).
+
+    def verify_signature_sets_triaged(self, sets) -> list:
+        """Per-set verdicts for a batch, bit-identical to running the
+        python oracle per set.
+
+        Route: grouped device dispatch + pack-once refinement
+        (_triage_device); LHTPU_VERDICT_GROUPS=0 or any failure the
+        resilience layer can't retry in place degrades to the host-side
+        budgeted bisection over verify_signature_sets — the ladder
+        semantics of the scalar entry point, per set."""
+        global _LAST_TRIAGE
+        sets = list(sets)
+        n = len(sets)
+        if n == 0:
+            return []
+        out = [False] * n
+        # Host-side structural rejections are per-set here (the scalar
+        # entry point fails the whole batch; reference:
+        # impls/blst.rs:79-88).
+        live_idx = [
+            i for i, s in enumerate(sets)
+            if s.signing_keys and not s.signature.is_infinity()
+        ]
+        _LAST_TRIAGE = {
+            "enabled": True,
+            "sets": n,
+            "groups": 0,
+            "rounds": 0,
+            "dispatches": 0,
+            "clean_groups": 0,
+            "poisoned_groups": 0,
+            "structural_rejects": n - len(live_idx),
+            "fallback": None,
+        }
+        if not live_idx:
+            return out
+        live = [sets[i] for i in live_idx]
+        if _verdict_groups() == 0:
+            verdicts = self._triage_host_bisect(live, reason="disabled")
+        elif not resilience.enabled():
+            verdicts = self._triage_device(live)
+        else:
+            try:
+                verdicts = self._triage_device(live)
+            except Exception as exc:
+                self._record_rung_failure(exc)
+                resilience.DEGRADED_TOTAL.inc(path="triage-host-bisect")
+                _LOG.warn(
+                    "poison triage degraded to host bisection",
+                    cause=str(exc)[:200],
+                )
+                verdicts = self._triage_host_bisect(
+                    live, reason=f"degraded: {type(exc).__name__}"
+                )
+        for i, v in zip(live_idx, verdicts):
+            out[i] = bool(v)
+        return out
+
+    def _triage_host_bisect(self, sets, reason: str) -> list:
+        """Degraded triage: the pre-ISSUE-5 host bisection over the
+        scalar resilient entry point (crypto/bls/api.bisect_verify_sets)
+        — correct per-set verdicts at O(log n) full re-dispatches."""
+        _LAST_TRIAGE["fallback"] = reason
+        from .crypto.bls.api import bisect_verify_sets
+
+        return bisect_verify_sets(sets, backend=self.name)
+
+    def _pack_for_triage(self, sets, stages) -> _TriagePack:
+        """Assemble one chunk's padded device inputs through the normal
+        pack / hash_to_curve / scalars stage wrappers, but RETAIN every
+        grid on the host (_TriagePack) so refinement rounds slice
+        instead of re-packing. Same data layout as _dispatch's assembly;
+        no MSM schedule (grouped cores keep the per-lane scalar scan)."""
+        n = len(sets)
+        S = _next_pow2(n)
+        K = _next_pow2(max(len(s.signing_keys) for s in sets))
+        total_keys = sum(len(s.signing_keys) for s in sets)
+        DISPATCH_BATCH_SETS.observe(n)
+        DISPATCH_BATCH_KEYS.observe(total_keys)
+
+        from .crypto.bls.curve import g1_infinity, g2_infinity
+
+        inf1, inf2 = g1_infinity(), g2_infinity()
+        pk = _TriagePack(n=n, S=S, K=K)
+
+        def run_pack():
+            table_args = self._table_gather_args(sets, S, K)
+            if table_args is not None:
+                pk.tx, pk.ty = table_args[0], table_args[1]
+                pk.idx = np.asarray(table_args[2])
+                pk.pinf = np.asarray(table_args[3])
+            else:
+                agg = None
+                if _host_agg_wanted(K, S, total_keys):
+                    agg = self._host_aggregate_rows(sets, S)
+                if agg is not None:
+                    from .ops.points import _mont_batch
+
+                    pk.px = _mont_batch(
+                        [x for x, _, _ in agg]
+                    ).reshape(S, 1, 48)
+                    pk.py = _mont_batch(
+                        [y for _, y, _ in agg]
+                    ).reshape(S, 1, 48)
+                    pk.pinf = np.asarray(
+                        [i for _, _, i in agg], dtype=bool
+                    ).reshape(S, 1)
+                    pk.K = 1
+                else:
+                    pk.px, pk.py, pk.pinf = self._pack_pubkey_grid(
+                        sets, S, K, n, inf1
+                    )
+            sigs = [s.signature.point for s in sets] + [inf2] * (S - n)
+            pk.sx, pk.sy, pk.sinf = g2_to_dev(sigs)
+
+        _retry_stage("pack", stages, run_pack)
+        pk.mx, pk.my, pk.minf = _retry_stage(
+            "hash_to_curve", stages,
+            lambda: self._hash_messages(sets, S, inf2),
+        )
+        pk.r_bits = _retry_stage(
+            "scalars", stages, lambda: _rand_bits_array(S)
+        )
+        return pk
+
+    def _dispatch_grouped(self, pk: _TriagePack, n_groups: int, stages):
+        """Enqueue ONE grouped-verdict device program over a packed
+        chunk; returns the un-forced device bool[n_groups]. Route
+        mirrors _dispatch's (sharded-indexed / sharded / indexed /
+        fused / classic, "+triage" suffixed) minus the MSM and
+        host-fallback legs. Sharding additionally requires whole groups
+        per chip (n_groups and S divisible by the device count)."""
+        choice = _fused_choice()
+        self._last_rung = "fused" if choice == "1" else "classic"
+        n_dev = len(jax.devices())
+        shard = os.environ.get("LHTPU_SHARDED_VERIFY")
+        use_sharded = (
+            choice == "1"
+            and (
+                shard == "1"
+                or (shard is None and n_dev > 1
+                    and jax.default_backend() == "tpu")
+            )
+            and pk.S % n_dev == 0
+            and n_groups % n_dev == 0
+        )
+
+        def run():
+            tail = (
+                jnp.asarray(pk.sx), jnp.asarray(pk.sy), jnp.asarray(pk.sinf),
+                jnp.asarray(pk.mx), jnp.asarray(pk.my), jnp.asarray(pk.minf),
+                jnp.asarray(pk.r_bits),
+            )
+            if pk.tx is not None:
+                idx, pinf = jnp.asarray(pk.idx), jnp.asarray(pk.pinf)
+                if use_sharded:
+                    fn = _sharded_fused_grouped_fn(
+                        n_dev, n_groups, indexed=True
+                    )
+                    probe = _jit_cache_probe(fn, "sharded-indexed+triage")
+                    ok = fn(pk.tx, pk.ty, idx, pinf, *tail)
+                    self.last_path = "sharded-indexed+triage"
+                else:
+                    fn = (_verify_fused_indexed_grouped_jit if choice == "1"
+                          else _verify_indexed_grouped_jit)
+                    probe = _jit_cache_probe(fn, "indexed+triage")
+                    ok = fn(
+                        pk.tx, pk.ty, idx, pinf,
+                        (tail[0], tail[1]), tail[2],
+                        (tail[3], tail[4]), tail[5], tail[6],
+                        n_groups=n_groups,
+                    )
+                    self.last_path = "indexed+triage"
+            elif use_sharded:
+                fn = _sharded_fused_grouped_fn(n_dev, n_groups)
+                probe = _jit_cache_probe(fn, "sharded+triage")
+                ok = fn(
+                    jnp.asarray(pk.px), jnp.asarray(pk.py),
+                    jnp.asarray(pk.pinf), *tail,
+                )
+                self.last_path = "sharded+triage"
+            else:
+                fn = (_verify_fused_grouped_jit if choice == "1"
+                      else _verify_grouped_jit)
+                label = "fused+triage" if choice == "1" else "classic+triage"
+                probe = _jit_cache_probe(fn, label)
+                ok = fn(
+                    (jnp.asarray(pk.px), jnp.asarray(pk.py)),
+                    jnp.asarray(pk.pinf),
+                    (tail[0], tail[1]), tail[2],
+                    (tail[3], tail[4]), tail[5], tail[6],
+                    n_groups=n_groups,
+                )
+                self.last_path = label
+            probe()
+            return ok
+
+        ok = _retry_stage("dispatch", stages, run)
+        TRIAGE_DISPATCHES.inc()
+        if _LAST_TRIAGE.get("enabled"):
+            _LAST_TRIAGE["dispatches"] = _LAST_TRIAGE.get("dispatches", 0) + 1
+        global _LAST_PATH
+        _LAST_PATH = self.last_path
+        DISPATCH_BATCHES.inc(path=self.last_path)
+        return ok
+
+    def _triage_force(self, okd, pk: _TriagePack, n_groups: int, stages):
+        """Force one grouped verdict vector to host bools, with the
+        device_sync semantics of _verify_once: the sync runs under the
+        LHTPU_SYNC_DEADLINE_S deadline, and a transient failure is
+        retried by RE-DISPATCHING — from the retained pack, so even the
+        retry pays no pack/hash time. Non-transients raise to the
+        caller's host-bisection fallback."""
+        res_on = resilience.enabled()
+        policy = resilience.retry_policy()
+        attempt = 0
+        while True:
+            sync: dict[str, float] = {}
+            try:
+                with _stage("device_sync", sync):
+                    if res_on:
+                        vec = resilience.force_with_deadline(
+                            lambda: np.asarray(okd)
+                        )
+                    else:
+                        vec = np.asarray(okd)
+                return np.asarray(vec, dtype=bool)
+            except Exception as exc:
+                category, kind = resilience.classify(exc)
+                if (not res_on or category != resilience.TRANSIENT
+                        or attempt >= policy.max_retries):
+                    raise
+                attempt += 1
+                resilience.RETRIES_TOTAL.inc(stage="device_sync", kind=kind)
+                policy.sleep(attempt)
+                okd = self._dispatch_grouped(pk, n_groups, stages)
+            finally:
+                stages["device_sync"] = (
+                    stages.get("device_sync", 0.0)
+                    + sync.get("device_sync", 0.0)
+                )
+
+    def _triage_device(self, live) -> list:
+        """Grouped-dispatch triage over structurally-valid sets.
+
+        Round 1 packs once (chunked through the pipeline policy above
+        LHTPU_PIPELINE_MIN_SETS, so chunk i+1's host pack hides behind
+        chunk i's device verify exactly like the scalar path) and
+        dispatches G = LHTPU_VERDICT_GROUPS verdict groups per chunk.
+        Refinement rounds slice the retained packs down to the poisoned
+        groups and re-dispatch at group size cur_gs / G — geometric, so
+        the dispatch count is O(log_G poisoned-group-span), bottoming
+        out at group size 1 where each verdict is the EXACT per-set
+        pairing check (no host re-verification needed)."""
+        global _LAST_STAGES, _LAST_PATH
+        n = len(live)
+        stages: dict[str, float] = {}
+        _LAST_STAGES = stages
+        self.last_stage_seconds = stages
+        self._last_rung = None
+        VG = _verdict_groups()
+
+        out = np.zeros(n, dtype=bool)
+        packs: list = []   # [(offset, _TriagePack)] in batch order
+        flight: list = []  # [(offset, length, gs, G, device vector)]
+
+        pipelined = pipeline.should_pipeline(n)
+        spans = pipeline.triage_chunks(n) if pipelined else [(0, n)]
+        run = (
+            pipeline.PipelineRun(n, len(spans), mode="triage")
+            if pipelined else None
+        )
+        for off, length in spans:
+            chunk_stages: dict[str, float] = {}
+            pk = self._pack_for_triage(live[off:off + length], chunk_stages)
+            G = min(VG, pk.S)
+            okd = self._dispatch_grouped(pk, G, chunk_stages)
+            for k, v in chunk_stages.items():
+                stages[k] = stages.get(k, 0.0) + v
+            if run is not None:
+                run.note_chunk(chunk_stages)
+            packs.append((off, pk))
+            flight.append((off, length, pk.S // G, G, okd))
+
+        # Partition round-1 groups into clean (all sets valid) and
+        # poisoned (at least one invalid set somewhere in the group).
+        suspects: list[int] = []
+        n_groups_total = 0
+        for (off, length, gs, G, okd), (_, pk) in zip(flight, packs):
+            vec = self._triage_force(okd, pk, G, stages)
+            for j in range(G):
+                lo = j * gs
+                if lo >= length:
+                    break  # pure-padding groups (always read True)
+                hi = min(lo + gs, length)
+                n_groups_total += 1
+                if bool(vec[j]):
+                    TRIAGE_GROUPS.inc(outcome="clean")
+                    _LAST_TRIAGE["clean_groups"] += 1
+                    out[off + lo:off + hi] = True
+                else:
+                    TRIAGE_GROUPS.inc(outcome="poisoned")
+                    _LAST_TRIAGE["poisoned_groups"] += 1
+                    suspects.extend(range(off + lo, off + hi))
+        _LAST_TRIAGE["groups"] = n_groups_total
+        rounds = 1
+        cur_gs = max(gs for (_, _, gs, _, _) in flight)
+
+        # Refinement: re-dispatch ONLY the poisoned span, sliced out of
+        # the retained packs — no pack/hash_to_curve/scalars stage runs
+        # past this point (the acceptance test pins the histogram
+        # counts).
+        while suspects:
+            if cur_gs <= 1:
+                # Group size 1 verdicts are exact per-set checks:
+                # failing singletons are definitively invalid.
+                for i in suspects:
+                    out[i] = False
+                break
+            m = len(suspects)
+            S2 = _next_pow2(m)
+            gs2 = max(1, min(cur_gs // max(2, VG), S2))
+            G2 = S2 // gs2
+            pk2 = _slice_packs(packs, suspects)
+            if pk2 is None:
+                # Chunks disagree on pack mode (device table swapped
+                # mid-call): degrade just the suspect sets.
+                sub = self._triage_host_bisect(
+                    [live[i] for i in suspects], reason="mixed pack modes"
+                )
+                for i, v in zip(suspects, sub):
+                    out[i] = bool(v)
+                break
+            okd = self._dispatch_grouped(pk2, G2, stages)
+            vec = self._triage_force(okd, pk2, G2, stages)
+            rounds += 1
+            nxt: list[int] = []
+            for j in range(G2):
+                lo = j * gs2
+                if lo >= m:
+                    break
+                hi = min(lo + gs2, m)
+                if bool(vec[j]):
+                    TRIAGE_GROUPS.inc(outcome="clean")
+                    _LAST_TRIAGE["clean_groups"] += 1
+                    for t in range(lo, hi):
+                        out[suspects[t]] = True
+                else:
+                    TRIAGE_GROUPS.inc(outcome="poisoned")
+                    _LAST_TRIAGE["poisoned_groups"] += 1
+                    if gs2 == 1:
+                        out[suspects[lo]] = False  # exact singleton
+                    else:
+                        nxt.extend(suspects[lo:hi])
+            suspects = nxt
+            cur_gs = gs2
+
+        _LAST_TRIAGE["rounds"] = rounds
+        if resilience.enabled():
+            rung = self._last_rung or self._ladder()[0]
+            resilience.breaker(rung).record_success()
+        if run is not None:
+            self.last_path = (self.last_path or "") + "+pipeline"
+            _LAST_PATH = self.last_path
+            run.finish()
+        return out.tolist()
 
     def _dispatch(self, sets, path_override: str | None = None):
         """Common assembly + device dispatch; returns a host bool (for

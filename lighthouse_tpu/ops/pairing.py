@@ -209,6 +209,20 @@ def fp12_tree_prod(f, axis_size: int):
     return f[0]
 
 
+def fp12_tree_prod_groups(f, group_size: int):
+    """Per-group Fp12 products: ``f[G, n, ...] -> [G, ...]`` by binary
+    halving along axis 1 — the grouped-verdict twin of
+    :func:`fp12_tree_prod` (ISSUE 5). All G group folds run in one
+    batched halving chain; pad groups with Fp12 one."""
+    n = group_size
+    assert n & (n - 1) == 0, "pad to a power of two"
+    while n > 1:
+        half = n // 2
+        f = fp12_mul(f[:, :half], f[:, half:n])
+        n = half
+    return f[:, 0]
+
+
 def pairing(p_aff, p_inf, q_aff, q_inf):
     """Batched full pairing e(P, Q) (post-final-exp, comparable values)."""
     return final_exponentiation(miller_loop(p_aff, p_inf, q_aff, q_inf))

@@ -1,17 +1,23 @@
 """Multi-chip parallelism: mesh construction + sharded batch verification."""
 
 from .sharding import (
+    build_sharded_fused_grouped_indexed_verifier,
+    build_sharded_fused_grouped_verifier,
     build_sharded_fused_indexed_verifier,
     build_sharded_fused_smoke,
     build_sharded_fused_verifier,
+    build_sharded_grouped_verifier,
     build_sharded_verifier,
     make_mesh,
 )
 
 __all__ = [
+    "build_sharded_fused_grouped_indexed_verifier",
+    "build_sharded_fused_grouped_verifier",
     "build_sharded_fused_indexed_verifier",
     "build_sharded_fused_smoke",
     "build_sharded_fused_verifier",
+    "build_sharded_grouped_verifier",
     "build_sharded_verifier",
     "make_mesh",
 ]

@@ -294,6 +294,109 @@ def build_sharded_fused_smoke(mesh: Mesh):
     return body
 
 
+def build_sharded_grouped_verifier(mesh: Mesh, n_groups: int):
+    """Sharded classic-XLA GROUPED verifier (ISSUE 5): returns
+    ``bool[n_groups]`` instead of one AND-collapsed scalar.
+
+    Groups are chip-local by construction — the backend pads S and picks
+    n_groups so both divide the "dp" extent, hence a chip's contiguous
+    S-slice holds whole groups. Each chip computes its local
+    ``n_groups // dp`` verdicts with the single-chip grouped core, and
+    the ONLY collective is an all_gather of the per-chip verdict lanes
+    (shards are laid out in axis order, so the gather IS the global
+    vector). CPU-testable: no Pallas kernel bodies.
+    """
+    from ..jax_backend import _verify_core_grouped
+
+    dp = mesh.shape["dp"]
+    assert n_groups % dp == 0, "group count must divide the dp extent"
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp"), P("dp"), P("dp"),  # pk x/y/inf  [S, K, ...]
+            P("dp"), P("dp"), P("dp"),  # sig x/y/inf
+            P("dp"), P("dp"), P("dp"),  # msg x/y/inf
+            P("dp"),                    # r_bits
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def body(pk_x, pk_y, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        ok = _verify_core_grouped(
+            (pk_x, pk_y), pk_inf, (sx, sy), sinf, (mx, my), minf,
+            r_bits, n_groups // dp,
+        )
+        return jax.lax.all_gather(ok, "dp").reshape(-1)
+
+    return body
+
+
+def build_sharded_fused_grouped_verifier(mesh: Mesh, n_groups: int):
+    """Sharded fused-Pallas GROUPED verifier — the production grouped
+    path at multichip (same chip-local-groups contract as
+    :func:`build_sharded_grouped_verifier`; the fused core performs the
+    verdict-lane all_gather itself via ``axis="dp"``)."""
+    from ..jax_backend import _verify_core_fused_grouped
+
+    dp = mesh.shape["dp"]
+    assert n_groups % dp == 0, "group count must divide the dp extent"
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp"), P("dp"), P("dp"),  # pk x/y/inf  [S, K, ...]
+            P("dp"), P("dp"), P("dp"),  # sig x/y/inf
+            P("dp"), P("dp"), P("dp"),  # msg x/y/inf
+            P("dp"),                    # r_bits
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def body(pk_x, pk_y, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        return _verify_core_fused_grouped(
+            (pk_x, pk_y), pk_inf, (sx, sy), sinf, (mx, my), minf,
+            r_bits, n_groups // dp, axis="dp",
+        )
+
+    return body
+
+
+def build_sharded_fused_grouped_indexed_verifier(mesh: Mesh, n_groups: int):
+    """Grouped twin of :func:`build_sharded_fused_indexed_verifier`:
+    HBM-table gather inside the shard + fused grouped core. Triage's
+    highest-scale route — refinement rounds re-ship only index slices."""
+    from ..jax_backend import _verify_core_fused_grouped
+
+    dp = mesh.shape["dp"]
+    assert n_groups % dp == 0, "group count must divide the dp extent"
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(), P(),                   # table x/y planes, replicated
+            P("dp"), P("dp"),           # idx [S, K], lane_inf [S, K]
+            P("dp"), P("dp"), P("dp"),  # sig x/y/inf
+            P("dp"), P("dp"), P("dp"),  # msg x/y/inf
+            P("dp"),                    # r_bits
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def body(tx, ty, idx, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        px = tx[idx].astype(jnp.int32)
+        py = ty[idx].astype(jnp.int32)
+        return _verify_core_fused_grouped(
+            (px, py), pk_inf, (sx, sy), sinf, (mx, my), minf,
+            r_bits, n_groups // dp, axis="dp",
+        )
+
+    return body
+
+
 def build_sharded_fused_indexed_verifier(mesh: Mesh, with_msm: bool = False):
     """Sharded fused verifier fed from the HBM pubkey table.
 

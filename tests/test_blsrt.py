@@ -130,3 +130,20 @@ def test_build_sequential_table_matches_oracle():
     xs, ys, _ = g1_to_dev([g1.mul(i) for i in range(1, n + 1)])
     assert (table._host_x[:n] == xs.astype(np.uint8)).all()
     assert (table._host_y[:n] == ys.astype(np.uint8)).all()
+
+
+def test_incremental_table_builder_matches_scalarmul_golden():
+    """PR-5 satellite: the incremental builder (chunk i = chunk i-1 +
+    [chunk]G via ONE batched mixed add) must be limb-identical to the
+    all-scalar-mul reference builder it replaced — three chunks so two
+    incremental steps actually run."""
+    import numpy as np
+
+    from lighthouse_tpu import blsrt
+
+    n, chunk = 20, 8
+    new = blsrt.build_sequential_table(n, chunk=chunk)
+    old = blsrt._build_sequential_table_scalarmul(n, chunk=chunk)
+    assert len(new) == len(old) == n
+    assert np.array_equal(new._host_x[:n], old._host_x[:n])
+    assert np.array_equal(new._host_y[:n], old._host_y[:n])

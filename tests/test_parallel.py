@@ -366,3 +366,44 @@ def test_fused_collectives_match_host():
     assert np.array_equal(np.asarray(folded)[0], np.asarray(expect))
     # rank-0 masking: exactly one finite check-pair lane across the mesh
     assert int(np.asarray(n_fin)[0]) == 1
+
+
+@pytest.mark.slow  # one fresh grouped-core compile inside shard_map
+# (~2 min on XLA:CPU); the single-device grouped core is pinned fast in
+# tests/test_triage.py
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 virtual devices")
+@big_stack_thread
+def test_sharded_grouped_verifier_matches_oracle():
+    """Grouped verdicts across chips (ISSUE 5): groups are chip-local,
+    the only collective is the verdict-lane all_gather, so bool[G] must
+    name exactly the poisoned group — in axis order — on a CPU mesh."""
+    from lighthouse_tpu.parallel import build_sharded_grouped_verifier
+
+    S, K, G = 4, 4, 2
+    sks = [SecretKey.from_int(i + 3) for i in range(5)]
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    sets = [
+        SignatureSet.single_pubkey(sks[0].sign(msgs[0]), sks[0].public_key(), msgs[0]),
+        SignatureSet.multiple_pubkeys(
+            AggregateSignature.aggregate([sks[1].sign(msgs[1]), sks[2].sign(msgs[1])]),
+            [sks[1].public_key(), sks[2].public_key()],
+            msgs[1],
+        ),
+        SignatureSet.single_pubkey(sks[3].sign(msgs[2]), sks[3].public_key(), msgs[2]),
+        SignatureSet.single_pubkey(sks[4].sign(msgs[3]), sks[4].public_key(), msgs[3]),
+    ]
+
+    mesh = make_mesh(2, mp=1)  # dp=2: one group of 2 sets per chip
+    fn = jax.jit(build_sharded_grouped_verifier(mesh, G))
+
+    good = _flat_batch(sets, S, K)
+    ok = np.asarray(fn(*good))
+    assert ok.shape == (G,) and ok.all()
+
+    # Tamper set 2 (group 1): only that group's verdict flips.
+    bad = list(good)
+    sx = np.array(good[3])
+    sx[[2, 3]] = sx[[3, 2]]
+    bad[3] = sx
+    ok = np.asarray(fn(*bad))
+    assert ok.tolist() == [True, False]

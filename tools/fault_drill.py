@@ -34,6 +34,11 @@ STAGES = (
     "device_sync",
 )
 QUICK_STAGES = ("hash_to_curve", "dispatch", "device_sync")
+#: stages the grouped-triage path actually enters (it never builds an
+#: MSM schedule — per-group accumulators are incompatible with the
+#: single global MSM fold).
+TRIAGE_STAGES = ("pack", "hash_to_curve", "scalars", "dispatch",
+                 "device_sync")
 
 #: kind -> (classifier category, human label)
 KINDS = (
@@ -151,6 +156,112 @@ def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None,
     return results
 
 
+def _mk_poisoned_sets():
+    """The triage drill batch: 4 sets, index 2 signed over the wrong
+    message — round 1 at (S=4, G=2) plus one (S=2, G=2) gs=1 refinement,
+    the same two compile buckets tests/test_triage.py pays for."""
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        SecretKey,
+        SignatureSet,
+    )
+
+    sks = [SecretKey.from_int(i + 7) for i in range(6)]
+    bad_msg = b"\xee" * 32
+    sets = []
+    for i in range(4):
+        m = bytes([i + 1]) * 32
+        signed = bad_msg if i == 2 else m
+        if i % 2 == 0:
+            sets.append(SignatureSet.single_pubkey(
+                sks[i].sign(signed), sks[i].public_key(), m
+            ))
+        else:
+            a, b = sks[i], sks[i + 2]
+            agg = AggregateSignature.aggregate([a.sign(signed), b.sign(m)])
+            sets.append(SignatureSet.multiple_pubkeys(
+                agg, [a.public_key(), b.public_key()], m
+            ))
+    return sets, [True, True, False, True]
+
+
+def run_drill_triaged(stages=TRIAGE_STAGES, kinds=KINDS, backend=None):
+    """Poisoned-batch drill through verify_signature_sets_triaged
+    (ISSUE 5): every cell must keep the per-set verdicts bit-correct —
+    a transient is retried in place, a permanent fault may degrade to
+    the host bisection (fallback recorded) but NEVER crash or flip a
+    verdict."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
+
+    if backend is None:
+        backend = jb.JaxBackend()
+    sets, expected = _mk_poisoned_sets()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    results = []
+    try:
+        got = backend.verify_signature_sets_triaged(sets)
+        assert got == expected, f"healthy triage pass broken: {got}"
+        healthy_path = backend.last_path
+
+        for stage in stages:
+            for kind, category in kinds:
+                resilience.reset()
+                retries0 = _total(resilience.RETRIES_TOTAL)
+                degraded0 = _total(resilience.DEGRADED_TOTAL)
+                os.environ["LHTPU_FAULT_INJECT"] = f"{stage}:{kind}:1"
+                error = None
+                try:
+                    verdict = backend.verify_signature_sets_triaged(sets)
+                except Exception as exc:  # contract breach, not a crash
+                    verdict = None
+                    error = f"{type(exc).__name__}: {exc}"
+                finally:
+                    os.environ.pop("LHTPU_FAULT_INJECT", None)
+                retries = _total(resilience.RETRIES_TOTAL) - retries0
+                degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+                fallback = jb.dispatch_stage_report()["triage"].get(
+                    "fallback"
+                )
+                if category == "transient":
+                    ok = (verdict == expected and retries >= 1
+                          and degraded == 0 and fallback is None)
+                else:
+                    ok = verdict == expected and degraded >= 1
+                results.append({
+                    "mode": "triaged",
+                    "stage": stage,
+                    "kind": kind,
+                    "category": category,
+                    "verdict": verdict == expected if verdict is not None
+                    else None,
+                    "retries": retries,
+                    "degraded": degraded,
+                    "path": backend.last_path,
+                    "healthy_path": healthy_path,
+                    "fallback": fallback,
+                    "error": error,
+                    "ok": ok,
+                })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+    return results
+
+
 def main() -> int:
     json_mode = "--json" in sys.argv
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
@@ -158,13 +269,17 @@ def main() -> int:
 
     import jax
 
+    triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES)) * len(KINDS)}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages)) * len(KINDS)}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
     # mid-pipeline breaker trips must meet the same contract.
     results += run_drill(stages=QUICK_STAGES, pipelined=True)
+    # Poisoned-batch triage matrix (ISSUE 5): per-set verdicts must
+    # survive every cell — degrade to host bisection, never crash.
+    results += run_drill_triaged(stages=triage_stages)
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "

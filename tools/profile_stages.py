@@ -165,8 +165,19 @@ def main():
     f_cp = jax.block_until_ready(jnp.concatenate([f_c, ones]))
     timeit("fp12_tree_prod (XLA glue)", lambda: fp12_tree_prod(f_cp, M))
     f1 = jax.block_until_ready(fp12_tree_prod(f_cp, M))
-    timeit("final_exp kernel (1 lane)",
-           lambda: tc.final_exp_kernel_t(tk.batch_to_t(f1[None])))
+    fe1 = timeit("final_exp kernel (1 lane)",
+                 lambda: tc.final_exp_kernel_t(tk.batch_to_t(f1[None])))
+
+    # Grouped-verdict overhead (ISSUE 5): poison triage folds the Miller
+    # product per group, so the final exponentiation runs [G]-batched
+    # instead of on one collapsed lane. The delta between these two rows
+    # is the clean-batch price of carrying G verdicts per dispatch.
+    from lighthouse_tpu.jax_backend import _verdict_groups
+    G = _verdict_groups() or 32
+    fG = jax.block_until_ready(jnp.broadcast_to(f1[None], (G, *f1.shape)))
+    feG = timeit(f"final_exp kernel ({G} verdict lanes)",
+                 lambda: tc.final_exp_kernel_t(tk.batch_to_t(fG)))
+    record("grouped_verdict_final_exp_overhead", feG - fe1)
 
     # ------------------------------------------------ hash path stages
     from lighthouse_tpu.ops.htc import DST, hash_to_field_dev
@@ -207,6 +218,7 @@ def main():
             "stages_ms": STAGES_MS,
             "detail": {"S": S, "K": K,
                        "device": jax.devices()[0].platform,
+                       "verdict_groups": G,
                        "overlap": overlap},
         }), flush=True)
 
