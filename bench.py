@@ -178,13 +178,16 @@ def _emit_fallback(err: str) -> None:
     mode = os.environ.get("BENCH_MODE", "")
     chain = mode == "slot-chain" or "--slot-chain" in sys.argv
     slot = chain or mode == "slot" or "--slot" in sys.argv
-    metric = ("chain_slot_attester_verifications_per_sec" if chain
+    load = mode == "slot-load" or "--slot-load" in sys.argv
+    metric = ("slot_load_sets_per_sec" if load
+              else "chain_slot_attester_verifications_per_sec" if chain
               else "full_slot_attester_verifications_per_sec" if slot
               else "bls_sets_verified_per_sec")
     line = {
         "metric": metric,
         "value": 0.0,
-        "unit": "attester-signatures/sec" if slot else "sets/sec",
+        "unit": ("sets/sec" if load
+                 else "attester-signatures/sec" if slot else "sets/sec"),
         "vs_baseline": 0.0,
         "error": err[:400],
     }
@@ -254,6 +257,139 @@ def slot_chain_mode() -> None:
             "last_path": getattr(be, "last_path", None),
             "stages": _stage_report(),
             "device": jax.devices()[0].platform,
+            **_resilience_detail(),
+            **_pipeline_detail(),
+            **_triage_detail(),
+        },
+    }), flush=True)
+    global _HEADLINE_EMITTED
+    _HEADLINE_EMITTED = True
+
+
+def slot_load_mode() -> None:
+    """ISSUE 6 tentpole: a 1M-validator-shaped SLOT REPLAY served to an
+    SLO. Deterministic traffic (loadgen/traffic.py, seeded) paced on the
+    wall clock through the serving loop (loadgen/serve.py): deadline
+    batching, admission control, triage verdicts per event. Prints one
+    BENCH_SLOT-style JSON line whose ``detail.slo`` carries
+    p50/p99 enqueue→verdict latency, shed/drop counts and
+    ``within_budget``; ``stream_digest``/``verdict_digest`` prove
+    seed-reproducibility.
+
+    Knobs: BENCH_VALIDATORS / BENCH_SLOTS / BENCH_POISON / BENCH_SEED /
+    BENCH_SPS / BENCH_UNAGG / BENCH_COLD, plus the serving loop's
+    LHTPU_BATCH_TARGET / LHTPU_BATCH_DEADLINE_MS / LHTPU_ADMIT_HIGH /
+    LHTPU_ADMIT_LOW / LHTPU_SLO_BUDGET_MS. Off-TPU the shape shrinks
+    (committees<=2, committee_size<=4, short slots) so the CPU fallback
+    answers in seconds on reused compile buckets instead of paying
+    mainnet-sized XLA:CPU compiles."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    from lighthouse_tpu.chain.scale import slot_shape
+    from lighthouse_tpu.consensus.config import mainnet_spec
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.loadgen.serve import (
+        ServeConfig,
+        ServingLoop,
+        WallClock,
+        verdict_digest,
+    )
+    from lighthouse_tpu.loadgen.traffic import (
+        TrafficConfig,
+        TrafficGenerator,
+        stream_digest,
+    )
+
+    dev = jax.devices()[0].platform
+    tpu = dev == "tpu"
+    N = int(os.environ.get("BENCH_VALIDATORS", "1000000"))
+    slots = int(os.environ.get("BENCH_SLOTS", "2"))
+    poison = float(os.environ.get("BENCH_POISON", "0.0"))
+    seed = int(os.environ.get("BENCH_SEED", "20260805"))
+    sps = float(os.environ.get("BENCH_SPS", "12.0" if tpu else "1.0"))
+    unagg = int(os.environ.get("BENCH_UNAGG", "512" if tpu else "4"))
+
+    committees, csize = slot_shape(N, mainnet_spec())
+    if not tpu:
+        # CPU fallback: keep the mainnet-derived STRUCTURE but shrink it
+        # to shapes whose compile cost is test-tier.
+        committees, csize = min(committees, 2), min(csize, 4)
+
+    os.environ.setdefault("LHTPU_BATCH_TARGET", "256" if tpu else "4")
+    os.environ.setdefault("LHTPU_ADMIT_HIGH", "8192" if tpu else "64")
+    serve_cfg = ServeConfig.from_env()
+
+    traffic_cfg = TrafficConfig(
+        validators=N, slots=slots, seconds_per_slot=sps,
+        committees_per_slot=committees, committee_size=csize,
+        unaggregated_per_slot=unagg, poison_rate=poison, seed=seed,
+        key_pool=4096 if tpu else 32,
+    )
+    gen = TrafficGenerator(traffic_cfg)
+    t0 = time.perf_counter()
+    events = gen.generate()
+    prep_s = time.perf_counter() - t0
+    sdigest = stream_digest(events)
+
+    if os.environ.get("BENCH_COLD") != "1":
+        # Pay compiles for the batch shapes the replay will dispatch
+        # (full batches + stragglers) so the timed run sees steady state.
+        warm = [te.payload.sig_set for te in events]
+        for size in {min(serve_cfg.batch_target, len(warm)), 1}:
+            if size > 0:
+                bls_api.verify_signature_sets_triaged(
+                    warm[:size], backend="jax"
+                )
+
+    loop = ServingLoop(serve_cfg, clock=WallClock(), backend="jax")
+    t0 = time.perf_counter()
+    report = loop.run(events)
+    wall_s = time.perf_counter() - t0
+
+    slo = report["slo"]
+    served = report["events_served"]
+    # Ground-truth audit over ADMITTED events: triage verdicts must
+    # match the generator's intent exactly (mismatches==0 is the
+    # poison-storm acceptance gate).
+    ok = report["verdicts"]["mismatches"] == 0 and served > 0
+    print(json.dumps({
+        "metric": "slot_load_sets_per_sec",
+        "value": round(served / wall_s, 2) if ok else 0.0,
+        "unit": "sets/sec",
+        "vs_baseline": 0.0,
+        "detail": {
+            "validators": N, "slots": slots,
+            "committees": committees, "committee_size": csize,
+            "unaggregated_per_slot": unagg,
+            "seconds_per_slot": sps,
+            "poison_rate": poison, "seed": seed,
+            "events": len(events),
+            "events_served": served,
+            "verified": bool(ok),
+            "mismatches": report["verdicts"]["mismatches"],
+            "invalid_verdicts": report["verdicts"]["invalid"],
+            "stream_digest": sdigest,
+            "verdict_digest": verdict_digest(loop.verdicts),
+            "slo": slo,
+            "within_budget": slo["within_budget"],
+            "admission": report["admission"],
+            "batches": report["batches"],
+            "replay_wall_s": round(wall_s, 2),
+            "prep_s": round(prep_s, 2),
+            "serve_config": {
+                "batch_target": serve_cfg.batch_target,
+                "batch_deadline_ms": serve_cfg.batch_deadline_ms,
+                "admit_high": serve_cfg.admit_high,
+                "admit_low": serve_cfg.admit_low,
+            },
+            "device": dev,
+            "stages": _stage_report(),
             **_resilience_detail(),
             **_pipeline_detail(),
             **_triage_detail(),
@@ -454,6 +590,72 @@ def pipeline_sweep(backend, sets, reps: int, which: str) -> None:
             os.environ.pop("LHTPU_PIPELINE", None)
         else:
             os.environ["LHTPU_PIPELINE"] = prev
+
+
+def _message_dup_cli_arg() -> list[int] | None:
+    """Duplication factors of ``--message-dup`` (comma-separated), or
+    None when absent. Bare ``--message-dup`` means the default sweep."""
+    if "--message-dup" not in sys.argv:
+        return None
+    i = sys.argv.index("--message-dup")
+    if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("-"):
+        try:
+            return [int(x) for x in sys.argv[i + 1].split(",")]
+        except ValueError:
+            pass
+    return [1, 8, 64]
+
+
+def message_dup_sweep(backend, S: int, reps: int,
+                      factors: list[int]) -> None:
+    """``--message-dup``: e2e rate on batches where many sets share one
+    message — the gossip-attestation reality (a committee's unaggregated
+    attestations all sign the SAME data). One ``bls_message_dup_sweep``
+    JSON line per duplication factor; today every duplicate pays a full
+    hash-to-curve + verify lane, so these lines are the measured
+    baseline the future hash-to-curve dedup win must beat."""
+    from lighthouse_tpu.crypto.bls.api import (
+        AggregateSignature,
+        SignatureSet,
+    )
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+
+    pool = _mk_key_pool(min(S, 512))
+    for factor in factors:
+        distinct = max(1, S // max(1, factor))
+        h2g = {}  # one host hash per DISTINCT message (fixture only)
+        sets = []
+        for i in range(S):
+            msg = (40_000 + i % distinct).to_bytes(32, "big")
+            if msg not in h2g:
+                h2g[msg] = hash_to_g2(msg)
+            sk = (i % len(pool)) + 1
+            sets.append(SignatureSet.single_pubkey(
+                AggregateSignature(h2g[msg].mul(sk)),
+                pool[sk - 1], msg,
+            ))
+        try:
+            assert _forced_sets(backend, sets)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                assert _forced_sets(backend, sets)
+            dt = (time.perf_counter() - t0) / reps
+            print(json.dumps({
+                "metric": "bls_message_dup_sweep",
+                "value": round(S / dt, 3),
+                "unit": "sets/sec",
+                "detail": {
+                    "dup_factor": factor,
+                    "batch_sets": S,
+                    "distinct_messages": distinct,
+                    "e2e_sync_ms_per_batch": round(dt * 1e3, 2),
+                    "path": backend.last_path,
+                    **_pipeline_detail(),
+                    **_resilience_detail(),
+                },
+            }), flush=True)
+        except Exception as e:
+            _emit_config_fallback("bls_message_dup_sweep", factor, e)
 
 
 def _vs_target(e2e_rate: float, native_rate: float | None, detail: dict) -> float:
@@ -802,6 +1004,11 @@ def main() -> None:
     if pipe_arg is not None:
         pipeline_sweep(backend, sets, REPS, pipe_arg)
 
+    # --- optional --message-dup sweep (dedup-baseline JSON lines) -----------
+    dup_arg = _message_dup_cli_arg()
+    if dup_arg is not None:
+        message_dup_sweep(backend, S, REPS, dup_arg)
+
     # --- measured native CPU baseline (C++; BASELINE.md mandate) ------------
     detail = {
         "batch_sets": S,
@@ -903,7 +1110,10 @@ if __name__ == "__main__":
         if _probe_backend() is None:
             _emit_fallback("tpu-unavailable: backend init failed after retries")
             sys.exit(0)
-        if (os.environ.get("BENCH_MODE") == "slot-chain"
+        if (os.environ.get("BENCH_MODE") == "slot-load"
+                or "--slot-load" in sys.argv):
+            slot_load_mode()
+        elif (os.environ.get("BENCH_MODE") == "slot-chain"
                 or "--slot-chain" in sys.argv):
             slot_chain_mode()
         elif os.environ.get("BENCH_MODE") == "slot" or "--slot" in sys.argv:

@@ -1,8 +1,8 @@
 """Prometheus scrape endpoint (reference: beacon_node/http_metrics +
 the VC's equivalent): serves the global registry's text exposition on
 `/metrics`, a Chrome-trace dump of recent hot-path spans on `/trace`
-(load in chrome://tracing / ui.perfetto.dev), plus a bare liveness
-`/health`."""
+(load in chrome://tracing / ui.perfetto.dev), the last serving-loop
+SLO summary on `/slo`, plus a bare liveness `/health`."""
 
 from __future__ import annotations
 
@@ -32,6 +32,16 @@ class MetricsServer:
                 elif self.path == "/trace":
                     body = json.dumps(
                         {"traceEvents": tracing.chrome_trace()}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif self.path == "/slo":
+                    # most recent serving-loop run's SLO summary
+                    # (loadgen/slo.py); {} before any run
+                    from ..loadgen import slo
+
+                    body = json.dumps(
+                        slo.last_slo_report() or {}
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -42,6 +42,24 @@ from .beacon_chain import BeaconChain
 from .pubkey_cache import ValidatorPubkeyCache
 
 
+def slot_shape(n_validators: int, spec: ChainSpec) -> tuple[int, int]:
+    """(committees_per_slot, committee_size) for a registry of
+    ``n_validators`` active validators — the spec's
+    get_committee_count_per_slot formula without needing a state.
+    loadgen/traffic.py seeds its per-slot committee structure from
+    this; at mainnet 1M validators: 64 committees of ~488."""
+    p = spec.preset
+    committees = max(
+        1,
+        min(
+            p.MAX_COMMITTEES_PER_SLOT,
+            n_validators // p.SLOTS_PER_EPOCH // p.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+    size = max(1, n_validators // (p.SLOTS_PER_EPOCH * committees))
+    return committees, size
+
+
 def bulk_g2_mul(point, scalars: list[int]):
     """[k]P for one G2 point and many scalars.
 

@@ -261,7 +261,19 @@ def dispatch_stage_report() -> dict:
         "pipeline": pipeline.last_run_report(),
         "cache": _input_cache_report(),
         "triage": dict(_LAST_TRIAGE),
+        "slo": _slo_last_report(),
     }
+
+
+def _slo_last_report():
+    """Most recent serving-loop SLO summary (loadgen). Lazy + guarded:
+    the loadgen package must stay optional to this module's import."""
+    try:
+        from .loadgen import slo
+
+        return slo.last_slo_report()
+    except Exception:
+        return None
 
 
 def _input_cache_report() -> dict:

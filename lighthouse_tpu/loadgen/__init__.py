@@ -1,0 +1,19 @@
+"""loadgen — mainnet-shaped traffic generation + SLO-driven serving.
+
+Three modules (ISSUE 6 / ROADMAP "Stand up a mainnet-shaped load
+harness and serve it to an SLO"):
+
+* ``traffic``  — deterministic slot-realistic arrival processes
+  (committee structure, burstiness at slot boundaries, poison, fork
+  churn, skipped slots) rendered as timestamped ``WorkEvent`` streams.
+* ``serve``    — the serving loop: deadline-based adaptive batch
+  forming over ``network/processor.py``, admission control with
+  watermark hysteresis, graceful shedding, wall or virtual clock.
+* ``slo``      — enqueue→verdict latency quantiles (p50/p95/p99) per
+  work type, exported to the metrics registry and to
+  ``jax_backend.dispatch_stage_report()["slo"]`` / the ``/slo``
+  endpoint / ``bench.py --slot-load``.
+
+Only ``slo`` is import-light; import ``traffic``/``serve`` explicitly
+(they pull in the crypto and network layers).
+"""

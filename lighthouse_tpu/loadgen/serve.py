@@ -1,0 +1,340 @@
+"""SLO-driven serving loop over the BeaconProcessor.
+
+Drives timestamped traffic (``loadgen/traffic.py``) through
+BeaconProcessor → handlers → ``verify_signature_sets_triaged`` against
+either wall clock or a deterministic virtual clock:
+
+* **deadline-based adaptive batch forming** — the processor holds
+  partial BATCHED queues until ``batch_deadline_ms``; this loop sleeps
+  on ``next_deadline_ms()`` (the latency-hole fix) so a partial batch
+  fires AT its deadline instead of whenever the next event happens to
+  arrive;
+* **admission control** — watermark hysteresis on sheddable queue
+  depth: at ``admit_high`` queued events the gate closes and sheddable
+  gossip (attestations, aggregates, sync signatures) is rejected at
+  offer time; it reopens at ``admit_low``. Blocks are never shed.
+* **graceful shedding under poison storms** — bad sets cost extra
+  triage dispatches, queues back up, the watermark engages, and the
+  node keeps answering with bounded latency instead of melting;
+* **SLO accounting** — every served event's enqueue→verdict latency
+  lands in ``loadgen/slo.py`` (exact quantiles + registry histogram);
+  ``finish()`` publishes the run report to ``last_slo_report`` for
+  ``dispatch_stage_report()["slo"]``, ``/slo``, and bench JSON.
+
+With the virtual clock, handler wall time is invisible to the clock, so
+recorded latency is exactly queue wait + deadline wait — which is what
+the deadline-semantics unit tests pin down. ``bench.py --slot-load``
+uses the wall clock for end-to-end latencies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from dataclasses import dataclass
+
+from ..crypto.bls import api as bls_api
+from ..network.processor import BATCHED, BeaconProcessor, WorkEvent, WorkType
+from . import slo
+from .traffic import TimedEvent
+
+# Work that may be rejected under backpressure. Blocks (gossip or RPC)
+# are chain liveness — never shed.
+SHEDDABLE = {
+    WorkType.GOSSIP_ATTESTATION,
+    WorkType.GOSSIP_AGGREGATE,
+    WorkType.GOSSIP_SYNC_SIGNATURE,
+}
+
+# Default handlers verify these work types as signature sets.
+_SINGLE_VERIFIED = (WorkType.GOSSIP_SYNC_SIGNATURE, WorkType.GOSSIP_BLOCK)
+
+
+class WallClock:
+    """Real monotonic time; sleeping blocks the thread."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep_until(self, t: float) -> None:
+        delay = t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+
+class VirtualClock:
+    """Deterministic logical time; sleeping jumps the clock forward.
+
+    Handler execution takes zero virtual time, so enqueue→verdict
+    latency under this clock is pure scheduling latency (queue wait +
+    deadline wait) — fully reproducible."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def sleep_until(self, t: float) -> None:
+        if t > self._t:
+            self._t = float(t)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServeConfig:
+    batch_target: int = 256       # full-batch dispatch size
+    batch_deadline_ms: float = 250.0  # partial-batch latency budget
+    admit_high: int = 8192        # close the gate at this queue depth
+    admit_low: int | None = None  # reopen at this depth (None = high//2)
+    slo_budget_ms: float = 4000.0  # p99 target for within_budget
+
+    def __post_init__(self):
+        if self.admit_low is None:
+            self.admit_low = max(0, self.admit_high // 2)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """LHTPU_BATCH_TARGET / LHTPU_BATCH_DEADLINE_MS /
+        LHTPU_ADMIT_HIGH / LHTPU_ADMIT_LOW / LHTPU_SLO_BUDGET_MS, with
+        explicit ``overrides`` winning."""
+        cfg = {
+            "batch_target": int(_env_float("LHTPU_BATCH_TARGET", 256)),
+            "batch_deadline_ms": _env_float("LHTPU_BATCH_DEADLINE_MS", 250.0),
+            "admit_high": int(_env_float("LHTPU_ADMIT_HIGH", 8192)),
+            "slo_budget_ms": _env_float("LHTPU_SLO_BUDGET_MS", 4000.0),
+        }
+        if "LHTPU_ADMIT_LOW" in os.environ:
+            cfg["admit_low"] = int(_env_float("LHTPU_ADMIT_LOW", 0))
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class ServingLoop:
+    """Admission gate + deadline-driven drain over a BeaconProcessor."""
+
+    def __init__(self, config: ServeConfig | None = None, *,
+                 clock=None, backend: str | None = None,
+                 processor: BeaconProcessor | None = None,
+                 register_default_handlers: bool = True,
+                 verify=None):
+        self.cfg = config or ServeConfig()
+        self.clock = clock or WallClock()
+        self.backend = backend
+        # ``verify`` seam: list[SignatureSet] -> list[bool]. Default is
+        # the triage entry point (per-set verdicts, poison-tolerant).
+        self._verify = verify or (
+            lambda sets: bls_api.verify_signature_sets_triaged(
+                sets, backend=self.backend
+            )
+        )
+        if processor is None:
+            processor = BeaconProcessor(
+                attestation_batch_size=self.cfg.batch_target,
+                batch_deadline_ms=self.cfg.batch_deadline_ms,
+                clock=self.clock.now,
+            )
+        else:
+            # Adopt an existing processor (e.g. a ScaleChain's, with
+            # Router handlers already registered) onto this loop's
+            # clock and batching policy.
+            processor.set_clock(self.clock.now)
+            processor.attestation_batch_size = self.cfg.batch_target
+            processor.batch_deadline_ms = self.cfg.batch_deadline_ms
+        self.processor = processor
+
+        if register_default_handlers:
+            for wt in BATCHED:
+                self.processor.handlers.setdefault(wt, self._verify_batch)
+            for wt in _SINGLE_VERIFIED:
+                self.processor.handlers.setdefault(
+                    wt, lambda ev: self._verify_batch([ev])
+                )
+        # Instrument EVERY handler (default or adopted) so each served
+        # event records enqueue→verdict latency.
+        for wt, fn in list(self.processor.handlers.items()):
+            self.processor.handlers[wt] = self._instrument(
+                fn, wt, batched=wt in BATCHED
+            )
+
+        self.recorder = slo.LatencyRecorder()
+        self.verdicts: dict[int, bool] = {}
+        self.mismatches = 0
+        self.events_offered = 0
+        self.events_admitted = 0
+        self.shed_by_type: dict[str, int] = {}
+        self._admission_open = True
+        self._admission_engaged = False
+        self._transitions = 0
+        self._dropped_base = dict(self.processor.dropped())
+        self._batches_base = self.processor.batches_dispatched
+        slo.ADMISSION_OPEN.set(1)
+
+    # ------------------------------------------------------ instrumentation
+    def _instrument(self, handler, wt: WorkType, batched: bool):
+        if batched:
+            def wrapped(events: list[WorkEvent]):
+                handler(events)
+                t1 = self.clock.now()
+                for ev in events:
+                    t0 = getattr(ev, "_loadgen_enqueue_t", t1)
+                    self.recorder.observe(wt.value, max(0.0, t1 - t0))
+        else:
+            def wrapped(ev: WorkEvent):
+                handler(ev)
+                t1 = self.clock.now()
+                t0 = getattr(ev, "_loadgen_enqueue_t", t1)
+                self.recorder.observe(wt.value, max(0.0, t1 - t0))
+        return wrapped
+
+    def _verify_batch(self, events) -> None:
+        if isinstance(events, WorkEvent):
+            events = [events]
+        sets = [ev.payload.sig_set for ev in events]
+        verdicts = self._verify(sets)
+        for ev, ok in zip(events, verdicts):
+            p = ev.payload
+            self.verdicts[p.seq] = bool(ok)
+            if bool(ok) != p.expected:
+                self.mismatches += 1
+                slo.VERDICT_MISMATCHES.inc()
+
+    # ---------------------------------------------------------- admission
+    def _sheddable_depth(self) -> int:
+        return sum(len(self.processor.queues[wt]) for wt in SHEDDABLE)
+
+    def _admission_check(self) -> None:
+        depth = self._sheddable_depth()
+        if self._admission_open and depth >= self.cfg.admit_high:
+            self._admission_open = False
+            self._admission_engaged = True
+            self._transitions += 1
+            slo.ADMISSION_OPEN.set(0)
+            slo.ADMISSION_TRANSITIONS.inc(state="closed")
+        elif not self._admission_open and depth <= self.cfg.admit_low:
+            self._admission_open = True
+            self._transitions += 1
+            slo.ADMISSION_OPEN.set(1)
+            slo.ADMISSION_TRANSITIONS.inc(state="open")
+
+    # -------------------------------------------------------------- offer
+    def offer(self, event: WorkEvent) -> bool:
+        """Admission-gated enqueue; returns False when shed/dropped."""
+        self.events_offered += 1
+        if not self._admission_open and event.work_type in SHEDDABLE:
+            wt = event.work_type.value
+            self.shed_by_type[wt] = self.shed_by_type.get(wt, 0) + 1
+            slo.ADMISSION_SHED.inc(work_type=wt)
+            return False
+        event._loadgen_enqueue_t = self.clock.now()
+        sent = self.processor.send(event)
+        if sent:
+            self.events_admitted += 1
+            self._admission_check()
+        return sent
+
+    # --------------------------------------------------------------- drive
+    def _advance_to(self, target: float) -> None:
+        """Serve until the clock reaches ``target``: drain what is due,
+        then sleep exactly until the earliest partial-batch deadline
+        (or ``target``, whichever is sooner)."""
+        while True:
+            self.processor.process_pending()
+            self._admission_check()
+            nd = self.processor.next_deadline_ms()
+            if nd is None:
+                break
+            due = self.clock.now() + nd / 1e3
+            if due >= target:
+                break
+            # 1ns past the deadline: remaining-ms → seconds rounding can
+            # land a hair BEFORE it, where the queue is not yet overdue
+            # and the virtual clock would stop advancing (livelock).
+            self.clock.sleep_until(due + 1e-9)
+        self.clock.sleep_until(target)
+
+    def _drain_remaining(self) -> None:
+        """End of stream: serve every queued event, honoring pending
+        partial-batch deadlines."""
+        while True:
+            consumed = self.processor.process_pending()
+            self._admission_check()
+            nd = self.processor.next_deadline_ms()
+            if nd is None:
+                break
+            if nd <= 0.0 and consumed == 0:
+                break  # defensive: nothing due should remain unserved
+            self.clock.sleep_until(self.clock.now() + nd / 1e3 + 1e-9)
+
+    def run(self, events: list[TimedEvent]) -> dict:
+        """Replay a timestamped stream to completion; returns
+        ``finish()``'s report."""
+        start = self.clock.now()
+        for te in events:
+            self._advance_to(start + te.t)
+            self.offer(te.event)
+        self._drain_remaining()
+        return self.finish()
+
+    # -------------------------------------------------------------- report
+    def finish(self) -> dict:
+        lat = self.recorder.summary()
+        overall = lat["overall"]
+        shed = sum(self.shed_by_type.values())
+        dropped_now = self.processor.dropped()
+        dropped_by_type = {
+            k: v - self._dropped_base.get(k, 0)
+            for k, v in dropped_now.items()
+            if v - self._dropped_base.get(k, 0) > 0
+        }
+        dropped = sum(dropped_by_type.values())
+        report = {
+            "slo": {
+                "p50_ms": overall["p50_ms"],
+                "p95_ms": overall["p95_ms"],
+                "p99_ms": overall["p99_ms"],
+                "shed": shed,
+                "dropped": dropped,
+                "within_budget": bool(
+                    overall["count"] > 0
+                    and overall["p99_ms"] <= self.cfg.slo_budget_ms
+                ),
+                "budget_ms": self.cfg.slo_budget_ms,
+            },
+            "latency_ms": lat,
+            "events_offered": self.events_offered,
+            "events_admitted": self.events_admitted,
+            "events_served": self.recorder.count(),
+            "shed_by_type": dict(self.shed_by_type),
+            "dropped_by_type": dropped_by_type,
+            "verdicts": {
+                "served": len(self.verdicts),
+                "valid": sum(1 for v in self.verdicts.values() if v),
+                "invalid": sum(1 for v in self.verdicts.values() if not v),
+                "mismatches": self.mismatches,
+            },
+            "admission": {
+                "engaged": self._admission_engaged,
+                "transitions": self._transitions,
+                "open": self._admission_open,
+            },
+            "batches": self.processor.batches_dispatched - self._batches_base,
+        }
+        slo.set_last_report(report)
+        return report
+
+
+def verdict_digest(verdicts: dict[int, bool]) -> str:
+    """sha256 over (seq, verdict) in seq order — the reproducibility
+    fingerprint bench --slot-load embeds in its JSON."""
+    h = hashlib.sha256()
+    for seq in sorted(verdicts):
+        h.update(f"{seq}:{int(verdicts[seq])}|".encode())
+    return h.hexdigest()

@@ -1,0 +1,124 @@
+"""SLO layer: verification-latency quantiles next to the throughput
+metrics.
+
+Latency here is ENQUEUE→VERDICT — the time from a work event entering
+its BeaconProcessor queue to its handler (and therefore its signature
+verdict) completing — which is what a gossip peer actually experiences
+(queue wait + batch forming + device round trip). The serving loop
+records every served event into a :class:`LatencyRecorder`; exact
+quantiles come from the retained samples, and every observation is
+mirrored into the registry histogram below so ``/metrics`` scrapes see
+the same distribution.
+
+The most recent finished run's summary is kept module-global
+(:func:`last_slo_report`) so ``dispatch_stage_report()["slo"]``, the
+``/slo`` endpoint, and bench JSON lines all read one source.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..common.metrics import REGISTRY
+
+SLO_LATENCY_SECONDS = REGISTRY.histogram(
+    "slo_verification_latency_seconds",
+    "Enqueue-to-verdict latency of served work events",
+    ("work_type",),
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.0, 4.0, 8.0, 12.0),
+)
+SERVED_EVENTS = REGISTRY.counter(
+    "loadgen_served_events_total",
+    "Work events whose verdict completed through the serving loop",
+    ("work_type",),
+)
+ADMISSION_SHED = REGISTRY.counter(
+    "loadgen_admission_shed_total",
+    "Sheddable work events rejected by admission control",
+    ("work_type",),
+)
+ADMISSION_OPEN = REGISTRY.gauge(
+    "loadgen_admission_open",
+    "1 while the serving loop admits sheddable work, 0 under backpressure",
+)
+ADMISSION_TRANSITIONS = REGISTRY.counter(
+    "loadgen_admission_transitions_total",
+    "Admission-control state changes (watermark crossings)",
+    ("state",),
+)
+VERDICT_MISMATCHES = REGISTRY.counter(
+    "loadgen_verdict_mismatch_total",
+    "Served verdicts disagreeing with the traffic generator's ground truth",
+)
+
+
+def quantile(sorted_samples: list[float], q: float) -> float:
+    """Exact linear-interpolation quantile of an already-sorted list."""
+    if not sorted_samples:
+        return 0.0
+    if len(sorted_samples) == 1:
+        return sorted_samples[0]
+    pos = q * (len(sorted_samples) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_samples) - 1)
+    frac = pos - lo
+    return sorted_samples[lo] * (1.0 - frac) + sorted_samples[hi] * frac
+
+
+class LatencyRecorder:
+    """Per-work-type latency samples with exact quantile summaries."""
+
+    def __init__(self):
+        self._samples: dict[str, list[float]] = {}
+
+    def observe(self, work_type: str, seconds: float) -> None:
+        self._samples.setdefault(work_type, []).append(seconds)
+        SLO_LATENCY_SECONDS.observe(seconds, work_type=work_type)
+        SERVED_EVENTS.inc(work_type=work_type)
+
+    def count(self) -> int:
+        return sum(len(v) for v in self._samples.values())
+
+    @staticmethod
+    def _summarize(samples: list[float]) -> dict:
+        s = sorted(samples)
+        return {
+            "count": len(s),
+            "p50_ms": round(quantile(s, 0.50) * 1e3, 3),
+            "p95_ms": round(quantile(s, 0.95) * 1e3, 3),
+            "p99_ms": round(quantile(s, 0.99) * 1e3, 3),
+            "max_ms": round((s[-1] if s else 0.0) * 1e3, 3),
+        }
+
+    def summary(self) -> dict:
+        """{"overall": {...}, "per_type": {work_type: {...}}}."""
+        merged = [x for v in self._samples.values() for x in v]
+        return {
+            "overall": self._summarize(merged),
+            "per_type": {
+                wt: self._summarize(v) for wt, v in self._samples.items()
+            },
+        }
+
+
+_LOCK = threading.Lock()
+_LAST_REPORT: dict | None = None
+
+
+def set_last_report(report: dict) -> None:
+    global _LAST_REPORT
+    with _LOCK:
+        _LAST_REPORT = dict(report)
+
+
+def last_slo_report() -> dict | None:
+    """The most recent serving run's SLO summary (None before any run)."""
+    with _LOCK:
+        return dict(_LAST_REPORT) if _LAST_REPORT is not None else None
+
+
+def reset() -> None:
+    global _LAST_REPORT
+    with _LOCK:
+        _LAST_REPORT = None

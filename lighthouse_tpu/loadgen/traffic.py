@@ -1,0 +1,281 @@
+"""Deterministic mainnet-shaped traffic generator.
+
+Renders slot-realistic arrival processes as timestamped ``WorkEvent``
+streams for the serving loop (ISSUE 6 tentpole). The shape mirrors what
+a 1M-validator mainnet node sees on gossip each slot:
+
+* **committee structure** — committees_per_slot × committee_size from
+  the spec formula (``chain/scale.slot_shape``); every committee signs
+  ONE message, so unaggregated attestations arrive with the duplicated
+  message distribution the future HTC dedup will exploit;
+* **slot-boundary burstiness** — unaggregated attestations open at
+  slot_start + SPS/3 (the spec's attestation deadline), aggregates at
+  2·SPS/3 (the aggregation duty), each with a configurable burst
+  fraction landing inside a short window vs spread across the phase;
+* **poison** — a poisoned event's signature is computed over a
+  tampered message (ground truth ``expected=False`` rides the payload),
+  which is exactly what sustained bad gossip looks like to the triage
+  path;
+* **fork churn** — a churned committee votes a fork-variant message
+  (valid signature, different message): vote splits that defeat
+  message dedup;
+* **skipped slots** — no block event that slot.
+
+Everything is driven by one ``random.Random(seed)``: the same seed
+reproduces the identical stream bit-for-bit (``stream_digest`` proves
+it), which the bench's determinism acceptance and the oracle-parity
+tests rely on.
+
+Signatures use the sequential-key fixture trick shared with bench
+slot_mode: pool key i has sk = i+1, so a committee's aggregate
+signature is ``(sum sk_i mod r) * H(m)`` — one host hash per DISTINCT
+message (memoized) and one G2 mul per set, making 1M-validator-shaped
+streams cheap to mint on the host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass
+
+from ..chain.scale import slot_shape
+from ..consensus.config import mainnet_spec
+from ..crypto.bls.api import AggregateSignature, PublicKey, SignatureSet
+from ..crypto.bls.constants import R as CURVE_ORDER
+from ..crypto.bls.curve import g1_generator
+from ..crypto.bls.hash_to_curve import hash_to_g2
+from ..network.processor import WorkEvent, WorkType
+
+
+@dataclass
+class LoadPayload:
+    """What rides a generated WorkEvent: the signature set plus the
+    generator's ground truth for oracle-parity checks."""
+
+    seq: int
+    kind: str             # attestation | aggregate | sync | block
+    slot: int
+    sig_set: SignatureSet
+    expected: bool        # ground truth: False iff poisoned
+    message: bytes
+    members: tuple[int, ...]  # key-pool indices behind the signature
+    forked: bool = False
+
+
+@dataclass
+class TimedEvent:
+    t: float              # seconds from stream start (already time-scaled)
+    event: WorkEvent
+
+    @property
+    def payload(self) -> LoadPayload:
+        return self.event.payload
+
+
+@dataclass
+class TrafficConfig:
+    validators: int = 1_000_000
+    slots: int = 2
+    seconds_per_slot: float = 12.0
+    # None = derive both from ``validators`` via chain/scale.slot_shape
+    committees_per_slot: int | None = None
+    committee_size: int | None = None
+    unaggregated_per_slot: int = 64   # subnet-sampled single attestations
+    sync_per_slot: int = 0            # sync-committee signatures
+    blocks: bool = True
+    block_delay_s: float | None = None  # None = SPS/6 into the slot
+    burstiness: float = 0.8           # fraction arriving in the burst window
+    burst_window_s: float = 0.25
+    poison_rate: float = 0.0
+    fork_churn_rate: float = 0.0
+    skip_slot_prob: float = 0.0
+    key_pool: int = 64                # sequential-key fixture pool size
+    seed: int = 1234
+    time_scale: float = 1.0           # compress/stretch all timestamps
+
+    def resolved_shape(self) -> tuple[int, int]:
+        if self.committees_per_slot is not None:
+            return (
+                self.committees_per_slot,
+                self.committee_size if self.committee_size is not None else 1,
+            )
+        committees, size = slot_shape(self.validators, mainnet_spec())
+        if self.committee_size is not None:
+            size = self.committee_size
+        return committees, size
+
+
+def _msg32(tag: str) -> bytes:
+    return hashlib.sha256(tag.encode()).digest()
+
+
+def _tamper(msg: bytes) -> bytes:
+    return hashlib.sha256(b"lhtpu-poison|" + msg).digest()
+
+
+class TrafficGenerator:
+    """Seeded generator; ``generate()`` returns the full sorted stream."""
+
+    def __init__(self, cfg: TrafficConfig):
+        self.cfg = cfg
+        self._pool = self._build_pool(max(1, cfg.key_pool))
+        self._h2g_memo: dict[bytes, object] = {}
+
+    @staticmethod
+    def _build_pool(n: int) -> list[PublicKey]:
+        """Pool key i: sk = i+1, pk by running G1 addition (one host
+        point-add per key — the bench fixture trick)."""
+        g = g1_generator()
+        acc = g
+        out = []
+        for _ in range(n):
+            out.append(PublicKey(acc))
+            acc = acc.add(g)
+        return out
+
+    def _h2g(self, msg: bytes):
+        pt = self._h2g_memo.get(msg)
+        if pt is None:
+            pt = hash_to_g2(msg)
+            self._h2g_memo[msg] = pt
+        return pt
+
+    def _sig_set(self, members: tuple[int, ...], msg: bytes,
+                 poisoned: bool) -> SignatureSet:
+        sk_sum = sum(i + 1 for i in members) % CURVE_ORDER
+        signed = _tamper(msg) if poisoned else msg
+        sig = AggregateSignature(self._h2g(signed).mul(sk_sum))
+        pks = [self._pool[i] for i in members]
+        if len(pks) == 1:
+            return SignatureSet.single_pubkey(sig, pks[0], msg)
+        return SignatureSet.multiple_pubkeys(sig, pks, msg)
+
+    def _arrival(self, rng: random.Random, open_t: float,
+                 spread: float) -> float:
+        cfg = self.cfg
+        if rng.random() < cfg.burstiness:
+            return open_t + rng.random() * min(cfg.burst_window_s, spread)
+        return open_t + rng.random() * spread
+
+    def generate(self) -> list[TimedEvent]:
+        cfg = self.cfg
+        rng = random.Random(cfg.seed)
+        n_comm, comm_size = cfg.resolved_shape()
+        pool = len(self._pool)
+        sps = cfg.seconds_per_slot
+        phase = sps / 3.0
+        block_delay = (
+            cfg.block_delay_s if cfg.block_delay_s is not None else sps / 6.0
+        )
+
+        raw: list[tuple[float, int, WorkType, LoadPayload]] = []
+        seq = 0
+
+        def emit(t: float, wt: WorkType, kind: str, slot: int,
+                 members: tuple[int, ...], msg: bytes,
+                 poisoned: bool, forked: bool = False) -> None:
+            nonlocal seq
+            payload = LoadPayload(
+                seq=seq, kind=kind, slot=slot,
+                sig_set=self._sig_set(members, msg, poisoned),
+                expected=not poisoned, message=msg, members=members,
+                forked=forked,
+            )
+            raw.append((t, seq, wt, payload))
+            seq += 1
+
+        for s in range(cfg.slots):
+            base = s * sps
+            skipped = rng.random() < cfg.skip_slot_prob
+
+            # committee messages for this slot (fork churn decided once
+            # per committee so all its attestations split the same way)
+            comm_msg: list[tuple[bytes, bool]] = []
+            for ci in range(n_comm):
+                forked = rng.random() < cfg.fork_churn_rate
+                tag = "fork" if forked else "head"
+                comm_msg.append(
+                    (_msg32(f"lhtpu-att|{s}|{ci}|{tag}"), forked)
+                )
+
+            if cfg.blocks and not skipped:
+                proposer = (s * 31) % pool
+                emit(
+                    base + block_delay, WorkType.GOSSIP_BLOCK, "block", s,
+                    (proposer,), _msg32(f"lhtpu-block|{s}"),
+                    rng.random() < cfg.poison_rate,
+                )
+
+            att_open = base + phase       # spec attestation deadline
+            agg_open = base + 2.0 * phase  # aggregation duty
+
+            for j in range(cfg.unaggregated_per_slot):
+                ci = j % max(1, n_comm)
+                msg, forked = (
+                    comm_msg[ci] if comm_msg
+                    else (_msg32(f"lhtpu-att|{s}|0|head"), False)
+                )
+                member = ((s * cfg.unaggregated_per_slot + j) * 7 + ci) % pool
+                emit(
+                    self._arrival(rng, att_open, phase),
+                    WorkType.GOSSIP_ATTESTATION, "attestation", s,
+                    (member,), msg, rng.random() < cfg.poison_rate,
+                    forked=forked,
+                )
+
+            for j in range(cfg.sync_per_slot):
+                member = (s * 13 + j * 3 + 1) % pool
+                emit(
+                    self._arrival(rng, att_open, phase),
+                    WorkType.GOSSIP_SYNC_SIGNATURE, "sync", s,
+                    (member,), _msg32(f"lhtpu-sync|{s}"),
+                    rng.random() < cfg.poison_rate,
+                )
+
+            for ci in range(n_comm):
+                msg, forked = comm_msg[ci]
+                start = (s * n_comm + ci) * comm_size
+                members = tuple(
+                    (start + j) % pool for j in range(comm_size)
+                )
+                emit(
+                    self._arrival(rng, agg_open, phase),
+                    WorkType.GOSSIP_AGGREGATE, "aggregate", s,
+                    members, msg, rng.random() < cfg.poison_rate,
+                    forked=forked,
+                )
+
+        raw.sort(key=lambda r: (r[0], r[1]))
+        return [
+            TimedEvent(
+                t=t * cfg.time_scale,
+                event=WorkEvent(
+                    work_type=wt, payload=payload,
+                    peer_id=f"loadgen-{payload.seq % 16}", seen_slot=payload.slot,
+                ),
+            )
+            for t, _, wt, payload in raw
+        ]
+
+
+def expected_verdicts(events: list[TimedEvent]) -> dict[int, bool]:
+    """Ground truth per seq — what a perfect verifier must answer."""
+    return {te.payload.seq: te.payload.expected for te in events}
+
+
+def stream_digest(events: list[TimedEvent]) -> str:
+    """Canonical sha256 of the stream: timestamps, ordering, work
+    types, message bytes, membership, and ground truth. Two runs with
+    the same TrafficConfig must produce the same digest (the bench's
+    determinism acceptance check)."""
+    h = hashlib.sha256()
+    for te in events:
+        p = te.payload
+        h.update(
+            f"{te.t:.6f}|{p.seq}|{te.event.work_type.value}|{p.kind}|"
+            f"{p.slot}|{int(p.expected)}|{int(p.forked)}|"
+            f"{','.join(map(str, p.members))}|".encode()
+        )
+        h.update(p.message)
+    return h.hexdigest()

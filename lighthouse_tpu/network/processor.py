@@ -70,6 +70,12 @@ QUEUE_DEPTH = REGISTRY.gauge(
     "Current queued events",
     ("work_type",),
 )
+DEADLINE_OVERSHOOT_MS = REGISTRY.histogram(
+    "beacon_processor_deadline_overshoot_ms",
+    "How far past batch_deadline_ms a partial batch actually fired",
+    ("work_type",),
+    buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0, 5000.0),
+)
 
 
 class WorkType(str, Enum):
@@ -114,6 +120,9 @@ class _Queue:
     items: deque = field(default_factory=deque)
     times: deque = field(default_factory=deque)  # arrival order, parallel
     dropped: int = 0
+    # Clock seam: the serving loop (loadgen/serve.py) substitutes a
+    # deterministic virtual clock so deadline semantics are testable.
+    now: Callable[[], float] = time.monotonic
 
     def push(self, event: WorkEvent) -> bool:
         if len(self.items) >= self.maxlen:
@@ -128,7 +137,7 @@ class _Queue:
                 DROPPED_TOTAL.inc(work_type=self.kind)
                 return False
         self.items.append(event)
-        self.times.append(time.monotonic())
+        self.times.append(self.now())
         QUEUE_DEPTH.set(len(self.items), work_type=self.kind)
         return True
 
@@ -142,7 +151,7 @@ class _Queue:
             t = self.times.popleft()
             ev = self.items.popleft()
         QUEUE_LATENCY_SECONDS.observe(
-            time.monotonic() - t, work_type=self.kind
+            self.now() - t, work_type=self.kind
         )
         QUEUE_DEPTH.set(len(self.items), work_type=self.kind)
         return ev
@@ -150,7 +159,7 @@ class _Queue:
     def overdue(self, deadline_ms: float) -> bool:
         """Has the OLDEST queued entry waited past the deadline?"""
         return bool(self.times) and (
-            (time.monotonic() - self.times[0]) * 1e3 >= deadline_ms
+            (self.now() - self.times[0]) * 1e3 >= deadline_ms
         )
 
     def drain(self, limit: int) -> list[WorkEvent]:
@@ -212,19 +221,25 @@ class BeaconProcessor:
     """Bounded prioritized queues + batch-coalescing drain loop."""
 
     def __init__(self, attestation_batch_size: int = 1024,
-                 batch_deadline_ms: float = 0.0):
+                 batch_deadline_ms: float = 0.0,
+                 clock: Callable[[], float] | None = None):
         self.attestation_batch_size = attestation_batch_size
         # Adaptive batch-or-timeout accumulation (SURVEY §7.1 hard part
         # #3): with a nonzero deadline, a PARTIAL batch is held in its
         # queue until the oldest entry has waited deadline_ms — the
         # device prefers big batches, gossip wants bounded latency. 0 =
         # dispatch immediately (the reference's opportunistic drain).
-        # The deadline FIRES on the next process_* call after expiry, so
-        # the owner must poll periodically (NetworkService.poll on the
-        # node tick does); there is no internal timer.
+        # The deadline FIRES on the next process_* call after expiry;
+        # there is no internal timer — but next_deadline_ms() tells the
+        # owner exactly how long it may sleep before the earliest
+        # overdue queue needs a drain (loadgen/serve.py sleeps on it;
+        # NetworkService.poll still polls on the node tick).
         self.batch_deadline_ms = batch_deadline_ms
+        # ``clock`` (monotonic seconds) defaults to wall time; the
+        # serving loop substitutes a deterministic virtual clock.
+        self._now: Callable[[], float] = clock or time.monotonic
         self.queues: dict[WorkType, _Queue] = {
-            wt: _Queue(maxlen=m, lifo=lifo, kind=wt.value)
+            wt: _Queue(maxlen=m, lifo=lifo, kind=wt.value, now=self._now)
             for wt, (m, lifo) in QUEUE_SPECS.items()
         }
         # handlers: work_type -> fn(list[WorkEvent]) for batched types,
@@ -251,6 +266,39 @@ class BeaconProcessor:
     def dropped(self) -> dict[str, int]:
         return {wt.value: q.dropped for wt, q in self.queues.items() if q.dropped}
 
+    def set_clock(self, clock: Callable[[], float]) -> None:
+        """Rebind the processor (and every queue) to a new monotonic
+        clock — the serving loop adopts an existing processor (e.g. a
+        ScaleChain's, with Router handlers registered) onto its virtual
+        clock this way."""
+        self._now = clock
+        for q in self.queues.values():
+            q.now = clock
+
+    def next_deadline_ms(self) -> float | None:
+        """Milliseconds until the earliest queued BATCHED work becomes
+        due (0.0 = due right now), or None when no batched work is
+        queued. A full batch is always due immediately; with no
+        deadline configured any queued batched work is, too. This is
+        the batch_deadline_ms latency-hole fix: instead of polling
+        blind, the owner sleeps exactly this long and then drains
+        (non-batched work never waits — process_* dispatches it on the
+        next call regardless)."""
+        now = self._now()
+        best = None
+        for wt in BATCHED:
+            q = self.queues[wt]
+            if not len(q):
+                continue
+            if (self.batch_deadline_ms <= 0
+                    or len(q) >= self.attestation_batch_size):
+                return 0.0
+            remaining = self.batch_deadline_ms - (now - q.times[0]) * 1e3
+            remaining = max(0.0, remaining)
+            if best is None or remaining < best:
+                best = remaining
+        return best
+
     def process_one(self) -> int:
         """Dispatch the single highest-priority unit of work (one event,
         or one coalesced batch). Returns number of events consumed."""
@@ -263,9 +311,20 @@ class BeaconProcessor:
                 if (
                     self.batch_deadline_ms > 0
                     and len(q) < self.attestation_batch_size
-                    and not q.overdue(self.batch_deadline_ms)
                 ):
-                    continue  # keep accumulating toward a full batch
+                    if not q.overdue(self.batch_deadline_ms):
+                        continue  # keep accumulating toward a full batch
+                    # A partial batch firing past its deadline: record
+                    # by how much the dispatch overshot the latency
+                    # budget (0 when the owner drained exactly on time).
+                    DEADLINE_OVERSHOOT_MS.observe(
+                        max(
+                            0.0,
+                            (self._now() - q.times[0]) * 1e3
+                            - self.batch_deadline_ms,
+                        ),
+                        work_type=wt.value,
+                    )
                 batch = q.drain(self.attestation_batch_size)
                 BATCH_SIZE.observe(len(batch), work_type=wt.value)
                 if handler is not None:

@@ -33,6 +33,65 @@ class LocalBeaconNode:
         self.node.stop()
 
 
+class LocalLoadRig:
+    """A registry-scale chain served through the loadgen serving loop.
+
+    Couples ``chain/scale.ScaleChain`` (real BeaconChain, device-built
+    registry, Router batch handlers) with ``loadgen/serve.ServingLoop``
+    on a deterministic virtual clock: a slot's gossip-shaped aggregates
+    are replayed as timestamped work events through the SAME
+    BeaconProcessor the Router registered its handlers on, so SLO
+    latency accounting wraps the production verification path — not a
+    loadgen stand-in."""
+
+    def __init__(self, n_validators: int, spec=None, serve_config=None):
+        from ..chain.scale import ScaleChain
+        from ..consensus.config import minimal_spec
+        from ..loadgen.serve import ServeConfig, ServingLoop, VirtualClock
+
+        self.spec = spec if spec is not None else minimal_spec()
+        self.scale = ScaleChain(n_validators, self.spec)
+        self.clock = VirtualClock()
+        self.loop = ServingLoop(
+            serve_config or ServeConfig(batch_target=64,
+                                        batch_deadline_ms=200.0),
+            clock=self.clock,
+            processor=self.scale.processor,
+            register_default_handlers=False,
+        )
+
+    def replay_slot(self, slot: int) -> dict:
+        """Mint every committee's SignedAggregateAndProof for ``slot``
+        and serve them through the loop at aggregation-duty time
+        (2/3 into the slot), returning the run's SLO report."""
+        from ..loadgen.traffic import TimedEvent
+        from ..network.processor import WorkEvent, WorkType
+
+        self.scale.slot_clock.set_slot(slot)
+        self.scale.chain.per_slot_task()
+        aggregates = self.scale.make_slot_aggregates(slot)
+        sps = float(self.spec.SECONDS_PER_SLOT)
+        base = 2.0 * sps / 3.0
+        # 1ms spacing: the slot's aggregates land inside one
+        # batch-deadline window, so the Router verifies them as a
+        # single coalesced batch — the same device batch (and compile
+        # bucket) ScaleChain.drive_slot dispatches.
+        events = [
+            TimedEvent(
+                t=base + i * 1e-3,
+                event=WorkEvent(
+                    work_type=WorkType.GOSSIP_AGGREGATE, payload=sa,
+                    peer_id=f"rig-{i % 4}", seen_slot=slot,
+                ),
+            )
+            for i, sa in enumerate(aggregates)
+        ]
+        report = self.loop.run(events)
+        report["aggregates_minted"] = len(aggregates)
+        report["router_stats"] = dict(self.scale.router.stats)
+        return report
+
+
 class LocalValidatorClient:
     """A ValidatorClient wired to one-or-more local BNs over HTTP."""
 

@@ -1,0 +1,392 @@
+"""loadgen/: traffic generator + SLO-driven serving loop (ISSUE 6).
+
+Compile-budget discipline: jax-backend tests reuse the (S=4, K=2, G=2)
+and (S=2, K=2, G=2) triage buckets tests/test_triage.py pays for
+(batch_target=4, LHTPU_VERDICT_GROUPS=2, two-key aggregate traffic);
+deadline/admission/drop semantics run on a VirtualClock with an
+injected verify seam — no crypto, no compiles, exact timing."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common import resilience
+from lighthouse_tpu.loadgen import slo
+from lighthouse_tpu.loadgen.serve import (
+    ServeConfig,
+    ServingLoop,
+    VirtualClock,
+    verdict_digest,
+)
+from lighthouse_tpu.loadgen.traffic import (
+    TimedEvent,
+    TrafficConfig,
+    TrafficGenerator,
+    expected_verdicts,
+    stream_digest,
+)
+from lighthouse_tpu.network.processor import (
+    DEADLINE_OVERSHOOT_MS,
+    BeaconProcessor,
+    WorkEvent,
+    WorkType,
+)
+
+
+def _fake_loop(verify=None, **cfg):
+    """ServingLoop on a VirtualClock with an instant verify seam."""
+    return ServingLoop(
+        ServeConfig(**cfg), clock=VirtualClock(),
+        verify=verify or (lambda sets: [True] * len(sets)),
+    )
+
+
+class _P:
+    """Minimal payload standing in for LoadPayload in timing tests."""
+
+    def __init__(self, seq):
+        self.seq = seq
+        self.sig_set = object()
+        self.expected = True
+
+
+def _att(seq):
+    return WorkEvent(work_type=WorkType.GOSSIP_ATTESTATION, payload=_P(seq))
+
+
+def _overshoot_count():
+    h = DEADLINE_OVERSHOOT_MS
+    shard = h._shards.get(
+        h._label_key({"work_type": WorkType.GOSSIP_ATTESTATION.value})
+    )
+    return shard.count if shard else 0
+
+
+# ------------------------------------------------- deadline semantics
+
+
+def test_partial_batch_holds_until_deadline_then_fires():
+    """A partial batch must dispatch AT batch_deadline_ms on the virtual
+    clock — not before (accumulation) and not after (the latency hole
+    next_deadline_ms closes)."""
+    loop = _fake_loop(batch_target=4, batch_deadline_ms=100.0)
+    t0 = loop.clock.now()
+    loop.offer(_att(0))
+    loop.offer(_att(1))
+    # not yet due: processing now must keep accumulating
+    loop.processor.process_pending()
+    assert loop.recorder.count() == 0
+    loop._drain_remaining()
+    assert loop.recorder.count() == 2
+    # fired exactly at the deadline: latency == 100 ms for the oldest
+    lat = loop.recorder.summary()["overall"]
+    assert lat["max_ms"] == pytest.approx(100.0, abs=0.1)
+    assert loop.clock.now() - t0 == pytest.approx(0.1, abs=1e-3)
+
+
+def test_full_batch_fires_immediately():
+    loop = _fake_loop(batch_target=2, batch_deadline_ms=60_000.0)
+    loop.offer(_att(0))
+    loop.offer(_att(1))
+    assert loop.processor.next_deadline_ms() == 0.0  # full => due NOW
+    loop.processor.process_pending()
+    assert loop.recorder.count() == 2
+    # zero virtual time elapsed: no deadline wait was paid
+    assert loop.recorder.summary()["overall"]["max_ms"] == 0.0
+
+
+def test_next_deadline_ms_counts_down():
+    clock = VirtualClock()
+    proc = BeaconProcessor(
+        attestation_batch_size=4, batch_deadline_ms=100.0, clock=clock.now
+    )
+    assert proc.next_deadline_ms() is None  # nothing queued
+    proc.send(_att(0))
+    assert proc.next_deadline_ms() == pytest.approx(100.0)
+    clock.sleep_until(0.07)
+    assert proc.next_deadline_ms() == pytest.approx(30.0)
+    clock.sleep_until(0.25)
+    assert proc.next_deadline_ms() == 0.0  # overdue clamps to due-now
+
+
+def test_deadline_overshoot_histogram_records_late_fire():
+    """A drain that happens AFTER the deadline must record the overshoot
+    (how long the latency hole actually cost)."""
+    clock = VirtualClock()
+    proc = BeaconProcessor(
+        attestation_batch_size=4, batch_deadline_ms=100.0, clock=clock.now
+    )
+    proc.register(WorkType.GOSSIP_ATTESTATION, lambda evs: None)
+    before = _overshoot_count()
+    proc.send(_att(0))
+    clock.sleep_until(0.35)  # 250 ms past the deadline
+    assert proc.process_pending() == 1
+    assert _overshoot_count() == before + 1
+    shard = DEADLINE_OVERSHOOT_MS._shards[
+        DEADLINE_OVERSHOOT_MS._label_key(
+            {"work_type": WorkType.GOSSIP_ATTESTATION.value}
+        )
+    ]
+    assert shard.total >= 249.0  # ~250 ms overshoot observed
+
+
+# ------------------------------------------------- admission control
+
+
+def test_watermark_backpressure_sheds_and_recovers():
+    """admit_high=8/admit_low=2: exactly 8 of 20 offers admitted, 12
+    shed; a drain reopens the gate (hysteresis => exactly 2 state
+    transitions) and new work is admitted again."""
+    loop = _fake_loop(
+        batch_target=4, batch_deadline_ms=1e9, admit_high=8, admit_low=2
+    )
+    admitted = sum(1 for i in range(20) if loop.offer(_att(i)))
+    assert admitted == 8
+    assert loop.shed_by_type == {
+        WorkType.GOSSIP_ATTESTATION.value: 12
+    }
+    assert not loop._admission_open
+    # drain everything queued: depth 0 <= admit_low reopens the gate
+    loop._drain_remaining()
+    assert loop._admission_open
+    assert loop._transitions == 2
+    assert loop.offer(_att(99))
+    rep = loop.finish()
+    assert rep["admission"]["engaged"] is True
+    assert rep["slo"]["shed"] == 12
+    assert rep["events_offered"] == 21
+    assert rep["events_admitted"] == 9
+
+
+def test_blocks_never_shed():
+    loop = _fake_loop(batch_target=4, batch_deadline_ms=1e9,
+                      admit_high=2, admit_low=1)
+    for i in range(5):
+        loop.offer(_att(i))
+    assert not loop._admission_open
+    ev = WorkEvent(work_type=WorkType.GOSSIP_BLOCK, payload=_P(100))
+    assert loop.offer(ev)  # gate closed, block still admitted
+
+
+def test_exact_drop_accounting():
+    """Queue-full drops (distinct from admission sheds) are counted
+    exactly, per type, in the report."""
+    loop = _fake_loop(batch_target=64, batch_deadline_ms=1e9,
+                      admit_high=10_000)
+    q = loop.processor.queues[WorkType.GOSSIP_ATTESTATION]
+    q.maxlen = 3  # shrink the LIFO bound
+    for i in range(8):
+        loop.offer(_att(i))
+    assert q.dropped == 5  # LIFO evicts the oldest on overflow
+    rep = loop.finish()
+    assert rep["dropped_by_type"] == {
+        WorkType.GOSSIP_ATTESTATION.value: 5
+    }
+    assert rep["slo"]["dropped"] == 5
+
+
+# ------------------------------------------------- traffic determinism
+
+
+def _storm_cfg(seed=7):
+    """Aggregate-only two-key traffic: stays in the K=2 triage buckets
+    the suite already pays for (see module docstring)."""
+    return TrafficConfig(
+        validators=64, slots=2, seconds_per_slot=2.0,
+        committees_per_slot=2, committee_size=2,
+        unaggregated_per_slot=0, sync_per_slot=0, blocks=False,
+        poison_rate=0.4, fork_churn_rate=0.25, skip_slot_prob=0.0,
+        key_pool=8, seed=seed,
+    )
+
+
+def test_stream_digest_deterministic_per_seed():
+    a = TrafficGenerator(_storm_cfg(seed=7)).generate()
+    b = TrafficGenerator(_storm_cfg(seed=7)).generate()
+    c = TrafficGenerator(_storm_cfg(seed=8)).generate()
+    assert stream_digest(a) == stream_digest(b)
+    assert stream_digest(a) != stream_digest(c)
+    # structure sanity: sorted by time, aggregates only, 2 per slot
+    assert [te.event.work_type for te in a] == [
+        WorkType.GOSSIP_AGGREGATE
+    ] * 4
+    assert all(
+        a[i].t <= a[i + 1].t for i in range(len(a) - 1)
+    )
+
+
+def test_committee_shape_from_spec():
+    from lighthouse_tpu.chain.scale import slot_shape
+    from lighthouse_tpu.consensus.config import mainnet_spec
+
+    committees, size = slot_shape(1_000_000, mainnet_spec())
+    assert committees == 64
+    assert size == 1_000_000 // (32 * 64)  # ~488
+
+
+# ------------------------------------------------- oracle parity (jax)
+
+
+@pytest.fixture
+def triage_env(monkeypatch):
+    monkeypatch.setenv("LHTPU_VERDICT_GROUPS", "2")
+    monkeypatch.setenv("LHTPU_PIPELINE", "0")
+    monkeypatch.setenv("LHTPU_RETRY_BASE_MS", "0")
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+@pytest.mark.slow  # device parity sweep: several triage buckets plus
+# a python-oracle spot check; the fast-tier poison contract is covered
+# by test_fault_inject_smoke_degrades_not_crashes (poison_rate=0.25,
+# verdicts asserted bit-identical to ground truth)
+def test_poison_storm_parity_with_direct_triage(triage_env):
+    """A >=25%-poison storm served through the loop must (a) complete
+    with no unhandled exception, (b) yield verdicts bit-identical to
+    the generator's ground truth AND to direct
+    verify_signature_sets_triaged over the same sets, (c) publish a
+    well-formed SLO report."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.crypto.bls.api import verify_signature_sets_python
+
+    events = TrafficGenerator(_storm_cfg()).generate()
+    truth = expected_verdicts(events)
+    assert sum(1 for v in truth.values() if not v) >= 1  # storm is real
+
+    loop = ServingLoop(
+        ServeConfig(batch_target=4, batch_deadline_ms=100.0),
+        clock=VirtualClock(), backend="jax",
+    )
+    rep = loop.run(events)
+    assert loop.verdicts == truth
+    assert rep["verdicts"]["mismatches"] == 0
+
+    # direct-call oracle over the same sets, same seq order, same
+    # <=4-set chunking (stays in the paid compile buckets)
+    ordered = sorted(events, key=lambda te: te.payload.seq)
+    direct = {}
+    for lo in range(0, len(ordered), 4):
+        chunk = ordered[lo:lo + 4]
+        got = bls_api.verify_signature_sets_triaged(
+            [te.payload.sig_set for te in chunk], backend="jax"
+        )
+        direct.update({
+            te.payload.seq: bool(v) for te, v in zip(chunk, got)
+        })
+    assert direct == loop.verdicts
+
+    # python-oracle spot check: one good and one poisoned set
+    good = next(te for te in events if te.payload.expected)
+    bad = next(te for te in events if not te.payload.expected)
+    assert verify_signature_sets_python([good.payload.sig_set]) is True
+    assert verify_signature_sets_python([bad.payload.sig_set]) is False
+
+    for key in ("p50_ms", "p95_ms", "p99_ms", "shed", "dropped",
+                "within_budget", "budget_ms"):
+        assert key in rep["slo"]
+    assert rep["events_served"] == len(events)
+    # two replays of the same seed produce the same verdict fingerprint
+    loop2 = ServingLoop(
+        ServeConfig(batch_target=4, batch_deadline_ms=100.0),
+        clock=VirtualClock(), backend="jax",
+    )
+    loop2.run(TrafficGenerator(_storm_cfg()).generate())
+    assert verdict_digest(loop2.verdicts) == verdict_digest(loop.verdicts)
+
+
+def test_fault_inject_smoke_degrades_not_crashes(triage_env):
+    """The ISSUE 6 resilience smoke: loadgen replay under
+    LHTPU_FAULT_INJECT (transient AND permanent, injected mid-slot)
+    completes with ground-truth verdicts and a well-formed SLO report —
+    tools/fault_drill.py's slot-load rows, asserted in the fast tier."""
+    from tools.fault_drill import run_drill_slot_load
+
+    rows = run_drill_slot_load()
+    assert len(rows) == 2  # transient + permanent
+    for r in rows:
+        assert r["ok"], r
+        assert r["slo_ok"], r
+    transient = next(r for r in rows if r["category"] == "transient")
+    assert transient["retries"] >= 1 and transient["degraded"] == 0
+    permanent = next(r for r in rows if r["category"] == "permanent")
+    assert permanent["degraded"] >= 1
+
+
+# ------------------------------------------------- chain-mode rig
+
+
+@pytest.mark.slow  # builds a device registry table + the (S=8, K=4)
+# scale-chain bucket; fast-tier chain coverage stays in test_scale_chain
+def test_local_load_rig_serves_chain_slot():
+    """LocalLoadRig: a real ScaleChain slot (Router handlers, device
+    registry) replayed through the serving loop — aggregates verified
+    by the chain, SLO latency recorded for each."""
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.testing.rig import LocalLoadRig
+
+    rig = LocalLoadRig(64)
+    try:
+        rep = rig.replay_slot(1)
+        assert rep["aggregates_minted"] >= 1
+        assert rep["router_stats"]["aggregates_verified"] == (
+            rep["aggregates_minted"]
+        )
+        assert rep["router_stats"]["attestations_rejected"] == 0
+        assert rep["events_served"] == rep["aggregates_minted"]
+        assert rep["slo"]["within_budget"] is True
+        assert rep["latency_ms"]["overall"]["count"] == (
+            rep["aggregates_minted"]
+        )
+    finally:
+        blsrt.set_device_table(None)
+
+
+# ------------------------------------------------- SLO surfacing
+
+
+def test_slo_report_surfaces_everywhere():
+    """One serving run's summary must be readable from
+    last_slo_report(), dispatch_stage_report()['slo'], and /slo."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.api.http_metrics import MetricsServer
+
+    slo.reset()
+    assert slo.last_slo_report() is None
+    assert jb.dispatch_stage_report()["slo"] is None
+
+    loop = _fake_loop(batch_target=2, batch_deadline_ms=50.0)
+    loop.offer(_att(0))
+    rep = loop.run([TimedEvent(t=0.01, event=_att(1))])
+    assert slo.last_slo_report() == rep
+    assert jb.dispatch_stage_report()["slo"] == rep
+
+    srv = MetricsServer().start()
+    try:
+        with urllib.request.urlopen(srv.url + "/slo", timeout=5) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            served = json.loads(resp.read())
+        # JSON round trip: compare on the SLO core, which is primitive
+        assert served["slo"] == rep["slo"]
+        assert served["events_served"] == rep["events_served"]
+        with urllib.request.urlopen(
+            srv.url + "/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert "slo_verification_latency_seconds" in text
+    finally:
+        srv.stop()
+
+
+def test_latency_recorder_quantiles_exact():
+    r = slo.LatencyRecorder()
+    for ms in range(1, 101):  # 1..100 ms
+        r.observe("gossip_attestation", ms / 1e3)
+    s = r.summary()["overall"]
+    assert s["count"] == 100
+    assert s["p50_ms"] == pytest.approx(50.5)
+    assert s["p99_ms"] == pytest.approx(99.01)
+    assert s["max_ms"] == pytest.approx(100.0)
+    per = r.summary()["per_type"]["gossip_attestation"]
+    assert per["count"] == 100

@@ -262,6 +262,122 @@ def run_drill_triaged(stages=TRIAGE_STAGES, kinds=KINDS, backend=None):
     return results
 
 
+def run_drill_slot_load(kinds=KINDS, backend=None):
+    """Fault injection MID-SLOT into a loadgen replay (ISSUE 6
+    satellite): a tiny deterministic 2-slot poison-storm stream is
+    served through the ServingLoop on a virtual clock while
+    ``dispatch:<kind>:1`` fires inside the first verification batch.
+    Contract: the replay never crashes, every served verdict still
+    matches the generator's ground truth (transient → retried in place;
+    permanent → degraded to host bisection), and the SLO report stays
+    well-formed.
+
+    Shape economics: aggregate-only traffic at committee_size=2 with
+    batch_target=4 and a 100 ms deadline dispatches partial batches of
+    2 two-key sets — the (S=2, K=2, G=2) triage bucket
+    tests/test_triage.py already pays for; no new compiles."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
+    from lighthouse_tpu.loadgen.serve import (
+        ServeConfig,
+        ServingLoop,
+        VirtualClock,
+    )
+    from lighthouse_tpu.loadgen.traffic import (
+        TrafficConfig,
+        TrafficGenerator,
+        expected_verdicts,
+    )
+
+    if backend is None:
+        backend = jb.JaxBackend()
+
+    cfg = TrafficConfig(
+        validators=64, slots=2, seconds_per_slot=2.0,
+        committees_per_slot=2, committee_size=2,
+        unaggregated_per_slot=0, sync_per_slot=0, blocks=False,
+        poison_rate=0.25, key_pool=8, seed=7,
+    )
+    gen = TrafficGenerator(cfg)
+
+    def _serve():
+        loop = ServingLoop(
+            ServeConfig(batch_target=4, batch_deadline_ms=100.0),
+            clock=VirtualClock(),
+            verify=lambda sets: backend.verify_signature_sets_triaged(sets),
+        )
+        events = gen.generate()
+        report = loop.run(events)
+        return loop.verdicts, expected_verdicts(events), report
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    results = []
+    try:
+        got, expected, _ = _serve()  # healthy warm replay (pays compile)
+        assert got == expected and any(not v for v in expected.values()), (
+            f"healthy slot-load replay broken: {got} vs {expected}"
+        )
+        healthy_path = backend.last_path
+
+        for kind, category in kinds:
+            resilience.reset()
+            retries0 = _total(resilience.RETRIES_TOTAL)
+            degraded0 = _total(resilience.DEGRADED_TOTAL)
+            os.environ["LHTPU_FAULT_INJECT"] = f"dispatch:{kind}:1"
+            error = None
+            verdicts_ok = None
+            slo_ok = False
+            try:
+                got, expected, report = _serve()
+                verdicts_ok = got == expected
+                slo = report.get("slo") or {}
+                slo_ok = all(
+                    k in slo for k in
+                    ("p50_ms", "p99_ms", "shed", "dropped", "within_budget")
+                )
+            except Exception as exc:  # contract breach, not a crash
+                error = f"{type(exc).__name__}: {exc}"
+            finally:
+                os.environ.pop("LHTPU_FAULT_INJECT", None)
+            retries = _total(resilience.RETRIES_TOTAL) - retries0
+            degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+            if category == "transient":
+                ok = bool(verdicts_ok) and slo_ok and retries >= 1 \
+                    and degraded == 0
+            else:
+                ok = bool(verdicts_ok) and slo_ok and degraded >= 1
+            results.append({
+                "mode": "slot-load",
+                "stage": "dispatch",
+                "kind": kind,
+                "category": category,
+                "verdict": verdicts_ok,
+                "retries": retries,
+                "degraded": degraded,
+                "path": backend.last_path,
+                "healthy_path": healthy_path,
+                "slo_ok": slo_ok,
+                "error": error,
+                "ok": ok,
+            })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+    return results
+
+
 def main() -> int:
     json_mode = "--json" in sys.argv
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
@@ -271,7 +387,7 @@ def main() -> int:
 
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages)) * len(KINDS)}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS)}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -280,6 +396,9 @@ def main() -> int:
     # Poisoned-batch triage matrix (ISSUE 5): per-set verdicts must
     # survive every cell — degrade to host bisection, never crash.
     results += run_drill_triaged(stages=triage_stages)
+    # Serving-loop matrix (ISSUE 6): transients injected mid-slot into
+    # a loadgen poison-storm replay — degrade, never crash.
+    results += run_drill_slot_load()
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "
