@@ -379,6 +379,8 @@ def slot_load_mode() -> None:
             "slo": slo,
             "within_budget": slo["within_budget"],
             "admission": report["admission"],
+            "accounting": report.get("accounting"),
+            "health": report.get("health"),
             "batches": report["batches"],
             "replay_wall_s": round(wall_s, 2),
             "prep_s": round(prep_s, 2),

@@ -2,7 +2,10 @@
 the VC's equivalent): serves the global registry's text exposition on
 `/metrics`, a Chrome-trace dump of recent hot-path spans on `/trace`
 (load in chrome://tracing / ui.perfetto.dev), the last serving-loop
-SLO summary on `/slo`, plus a bare liveness `/health`."""
+SLO summary on `/slo`, plus readiness on `/health`: the governor's
+state + per-sentinel detail (common/health.py), HTTP 200 while
+healthy/degraded and 503 once critical — a k8s-style readiness probe,
+not the old bare liveness."""
 
 from __future__ import annotations
 
@@ -46,9 +49,12 @@ class MetricsServer:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 elif self.path == "/health":
-                    body = b"OK"
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    from ..common import health
+
+                    report = health.health_report()
+                    body = json.dumps(report).encode()
+                    self.send_response(200 if report["ready"] else 503)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found"
                     self.send_response(404)

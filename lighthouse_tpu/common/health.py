@@ -1,0 +1,431 @@
+"""Process health governor: a healthy/degraded/critical state machine
+fed by pluggable sentinels, scoring *lifetime* erosion the per-dispatch
+resilience layer cannot see.
+
+PR 2's retries/breakers judge one dispatch; PR 6's serving loop judges
+one slot window. Nothing judged the trajectory — a jit cache that grows
+monotonically, an RSS curve that never flattens, breakers that flap
+open/closed for hours, an SLO p99 that breaches every slot. Each
+sentinel watches one such trajectory and reports a level; the governor
+is the max over sentinels:
+
+* :class:`RssGrowthSentinel` — RSS growth rate over a sliding window
+  (``LHTPU_RSS_WINDOW_S``); degraded past ``LHTPU_RSS_GROWTH_MB`` of
+  growth inside the window, critical past an absolute
+  ``LHTPU_RSS_CRITICAL_MB`` ceiling. psutil-free via
+  :func:`monitoring.read_rss_bytes`.
+* :class:`JitCacheSentinel` — estimated jit-cache entries vs the
+  ``LHTPU_JIT_CACHE_MAX`` watermark. Crossing the watermark fires a
+  *counted* cache clear (``jax.clear_caches()`` + blsrt input-arena
+  prune, ``bls_jit_cache_clears_total{cause=watermark}``) exactly once
+  per crossing — the sentinel re-arms only after the count drops below
+  the watermark.
+* :class:`CacheHitRateSentinel` — pubkey-row / hash-to-curve input
+  cache hit-rate collapse (windowed delta rate below
+  ``LHTPU_CACHE_HIT_FLOOR`` once ``LHTPU_CACHE_MIN_SAMPLES`` lookups
+  accumulate).
+* :class:`BreakerFlapSentinel` — ``bls_breaker_transitions_total``
+  delta inside ``LHTPU_FLAP_WINDOW_S``; more than ``LHTPU_FLAP_MAX``
+  transitions is flapping (degraded), and any rung currently open is
+  at least degraded.
+* :class:`SloBreachSentinel` — consecutive p99-over-budget reports
+  (fed by ``ServingLoop.finish``); a streak of
+  ``LHTPU_SLO_BREACH_STREAK`` is degraded, twice that is critical.
+
+Consumers: ``ServingLoop._admission_check`` sheds earlier when
+degraded, ``dispatch_stage_report()["health"]`` and the ``/health``
+endpoint surface the report, and ``loadgen/soak.py`` scores
+``degraded_time_fraction`` from it. All sentinels take an injectable
+clock and probes so unit tests drive them on a virtual clock.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import monitoring
+from .metrics import REGISTRY
+
+HEALTHY, DEGRADED, CRITICAL = 0, 1, 2
+_LEVEL_NAMES = {HEALTHY: "healthy", DEGRADED: "degraded", CRITICAL: "critical"}
+
+HEALTH_STATE = REGISTRY.gauge(
+    "lhtpu_health_state",
+    "Governor health state (0=healthy, 1=degraded, 2=critical)",
+)
+SENTINEL_STATE = REGISTRY.gauge(
+    "lhtpu_health_sentinel_state",
+    "Per-sentinel health level (0=healthy, 1=degraded, 2=critical)",
+    ("sentinel",),
+)
+HEALTH_TRANSITIONS = REGISTRY.counter(
+    "lhtpu_health_transitions_total",
+    "Governor state changes, by destination state",
+    ("to",),
+)
+
+
+def level_name(level: int) -> str:
+    return _LEVEL_NAMES.get(level, str(level))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+class Sentinel:
+    """One watched trajectory. ``check(now)`` returns (level, detail);
+    implementations must be cheap — the governor runs every sentinel
+    per :meth:`HealthGovernor.check`."""
+
+    name = "sentinel"
+
+    def check(self, now: float) -> tuple[int, dict]:
+        raise NotImplementedError
+
+
+class RssGrowthSentinel(Sentinel):
+    """Degraded when RSS grows more than ``growth_mb`` inside
+    ``window_s``; critical past ``critical_mb`` absolute."""
+
+    name = "rss_growth"
+
+    def __init__(self, window_s: float | None = None,
+                 growth_mb: float | None = None,
+                 critical_mb: float | None = None,
+                 read_rss=monitoring.read_rss_bytes):
+        self.window_s = (_env_float("LHTPU_RSS_WINDOW_S", 60.0)
+                         if window_s is None else window_s)
+        self.growth_mb = (_env_float("LHTPU_RSS_GROWTH_MB", 512.0)
+                          if growth_mb is None else growth_mb)
+        self.critical_mb = (_env_float("LHTPU_RSS_CRITICAL_MB", 16384.0)
+                            if critical_mb is None else critical_mb)
+        self._read_rss = read_rss
+        self._samples: deque[tuple[float, int]] = deque()
+
+    def check(self, now: float) -> tuple[int, dict]:
+        rss = self._read_rss()
+        monitoring.RSS_BYTES.set(rss)
+        self._samples.append((now, rss))
+        cutoff = now - self.window_s
+        while len(self._samples) > 1 and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+        growth = rss - self._samples[0][1]
+        detail = {
+            "rss_mb": round(rss / 2**20, 1),
+            "window_growth_mb": round(growth / 2**20, 1),
+            "growth_budget_mb": self.growth_mb,
+        }
+        if rss / 2**20 > self.critical_mb:
+            return CRITICAL, detail
+        if growth / 2**20 > self.growth_mb:
+            return DEGRADED, detail
+        return HEALTHY, detail
+
+
+class JitCacheSentinel(Sentinel):
+    """Watermark the jit-cache entry estimate; crossing it fires ONE
+    counted clear and reports degraded until the count falls back."""
+
+    name = "jit_cache"
+
+    def __init__(self, max_entries: int | None = None,
+                 entries_fn=monitoring.jit_cache_entry_count,
+                 clear_fn=None):
+        self.max_entries = (_env_int("LHTPU_JIT_CACHE_MAX", 512)
+                            if max_entries is None else max_entries)
+        self._entries = entries_fn
+        self._clear = clear_fn if clear_fn is not None else _clear_jit_caches
+        self._armed = True
+        self.clears = 0
+
+    def check(self, now: float) -> tuple[int, dict]:
+        entries = self._entries()
+        cleared = False
+        if entries > self.max_entries:
+            if self._armed:
+                self._armed = False
+                self.clears += 1
+                cleared = True
+                self._clear()
+                entries = self._entries()
+        else:
+            self._armed = True
+        detail = {
+            "entries": entries,
+            "max_entries": self.max_entries,
+            "clears": self.clears,
+            "cleared_now": cleared,
+        }
+        level = DEGRADED if entries > self.max_entries else HEALTHY
+        return level, detail
+
+
+def _clear_jit_caches() -> None:
+    """The default watermark action: drop JAX's compilation caches and
+    the blsrt input arenas, re-baselining the entry estimate."""
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
+        from .. import blsrt
+
+        blsrt.reset_input_caches()
+    except Exception:
+        pass
+    monitoring.note_jit_cache_cleared(cause="watermark")
+
+
+class CacheHitRateSentinel(Sentinel):
+    """Input-cache (pubkey rows / hash-to-curve) hit-rate collapse:
+    degraded when the *windowed* hit rate — hits/lookups since the last
+    check — drops below ``floor`` after ``min_samples`` lookups."""
+
+    name = "cache_hit_rate"
+
+    def __init__(self, floor: float | None = None,
+                 min_samples: int | None = None, report_fn=None):
+        self.floor = (_env_float("LHTPU_CACHE_HIT_FLOOR", 0.05)
+                      if floor is None else floor)
+        self.min_samples = (_env_int("LHTPU_CACHE_MIN_SAMPLES", 4096)
+                            if min_samples is None else min_samples)
+        self._report = report_fn if report_fn is not None else _input_caches
+        self._last: dict[str, tuple[float, float]] = {}
+
+    def check(self, now: float) -> tuple[int, dict]:
+        level = HEALTHY
+        detail: dict = {"floor": self.floor}
+        for cache, stats in self._report().items():
+            hits = float(stats.get("hit", 0))
+            lookups = hits + float(stats.get("miss", 0))
+            p_hits, p_lookups = self._last.get(cache, (0.0, 0.0))
+            self._last[cache] = (hits, lookups)
+            d_hits, d_lookups = hits - p_hits, lookups - p_lookups
+            if d_lookups < self.min_samples:
+                detail[cache] = {"window_lookups": int(d_lookups)}
+                continue
+            rate = d_hits / d_lookups
+            detail[cache] = {
+                "window_lookups": int(d_lookups),
+                "window_hit_rate": round(rate, 4),
+            }
+            if rate < self.floor:
+                level = max(level, DEGRADED)
+        return level, detail
+
+
+def _input_caches() -> dict:
+    from .. import blsrt
+
+    return blsrt.input_cache_report()
+
+
+class BreakerFlapSentinel(Sentinel):
+    """Breaker churn: more than ``max_flaps`` transitions inside
+    ``window_s`` is flapping (degraded); any rung currently open is
+    degraded too (the ladder is actively re-routing)."""
+
+    name = "breaker_flap"
+
+    def __init__(self, window_s: float | None = None,
+                 max_flaps: int | None = None,
+                 transitions_fn=None, states_fn=None):
+        from . import resilience
+
+        self.window_s = (_env_float("LHTPU_FLAP_WINDOW_S", 60.0)
+                         if window_s is None else window_s)
+        self.max_flaps = (_env_int("LHTPU_FLAP_MAX", 6)
+                          if max_flaps is None else max_flaps)
+        self._transitions = (transitions_fn if transitions_fn is not None
+                             else resilience.breaker_transitions_total)
+        self._states = (states_fn if states_fn is not None
+                        else resilience.breaker_states)
+        self._samples: deque[tuple[float, float]] = deque()
+
+    def check(self, now: float) -> tuple[int, dict]:
+        total = self._transitions()
+        self._samples.append((now, total))
+        cutoff = now - self.window_s
+        while len(self._samples) > 1 and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+        flaps = total - self._samples[0][1]
+        states = self._states()
+        open_rungs = [r for r, s in states.items() if s != "closed"]
+        detail = {
+            "window_transitions": int(flaps),
+            "max_flaps": self.max_flaps,
+            "non_closed_rungs": open_rungs,
+        }
+        if flaps > self.max_flaps:
+            return DEGRADED, detail
+        if open_rungs:
+            return DEGRADED, detail
+        return HEALTHY, detail
+
+
+class SloBreachSentinel(Sentinel):
+    """Consecutive p99-over-budget serving reports: ``streak`` in a row
+    is degraded, ``2*streak`` critical. Fed via :meth:`note` (the
+    serving loop calls it from ``finish``)."""
+
+    name = "slo_breach"
+
+    def __init__(self, streak: int | None = None):
+        self.streak = (_env_int("LHTPU_SLO_BREACH_STREAK", 3)
+                       if streak is None else streak)
+        self.current = 0
+
+    def note(self, p99_ms: float, budget_ms: float) -> None:
+        if budget_ms > 0 and p99_ms > budget_ms:
+            self.current += 1
+        else:
+            self.current = 0
+
+    def check(self, now: float) -> tuple[int, dict]:
+        detail = {"breach_streak": self.current, "streak_budget": self.streak}
+        if self.current >= 2 * self.streak:
+            return CRITICAL, detail
+        if self.current >= self.streak:
+            return DEGRADED, detail
+        return HEALTHY, detail
+
+
+def default_sentinels() -> list[Sentinel]:
+    return [
+        RssGrowthSentinel(),
+        JitCacheSentinel(),
+        CacheHitRateSentinel(),
+        BreakerFlapSentinel(),
+        SloBreachSentinel(),
+    ]
+
+
+class HealthGovernor:
+    """max-over-sentinels state machine with a transition counter and a
+    cached last report (cheap reads for the admission hot path)."""
+
+    def __init__(self, sentinels: list[Sentinel] | None = None,
+                 clock=time.monotonic):
+        self.sentinels = (default_sentinels() if sentinels is None
+                          else list(sentinels))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._last_report: dict = {
+            "state": level_name(HEALTHY), "ready": True, "sentinels": {},
+        }
+        HEALTH_STATE.set(HEALTHY)
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    def note_slo(self, p99_ms: float, budget_ms: float) -> None:
+        for s in self.sentinels:
+            if isinstance(s, SloBreachSentinel):
+                s.note(p99_ms, budget_ms)
+
+    def check(self) -> int:
+        """Run every sentinel; update state, gauges and the report."""
+        now = self._clock()
+        with self._lock:
+            level = HEALTHY
+            sentinels: dict = {}
+            for s in self.sentinels:
+                try:
+                    s_level, detail = s.check(now)
+                except Exception as exc:  # a broken probe is not critical
+                    s_level, detail = HEALTHY, {"error": repr(exc)}
+                SENTINEL_STATE.set(s_level, sentinel=s.name)
+                sentinels[s.name] = {
+                    "state": level_name(s_level), **detail,
+                }
+                level = max(level, s_level)
+            if level != self._state:
+                HEALTH_TRANSITIONS.inc(to=level_name(level))
+            self._state = level
+            HEALTH_STATE.set(level)
+            self._last_report = {
+                "state": level_name(level),
+                "ready": level < CRITICAL,
+                "sentinels": sentinels,
+            }
+            return level
+
+    def report(self) -> dict:
+        """The last :meth:`check`'s report (no sentinel run)."""
+        with self._lock:
+            return dict(self._last_report)
+
+
+_GOVERNOR: HealthGovernor | None = None
+_GOVERNOR_LOCK = threading.Lock()
+
+
+def governor() -> HealthGovernor:
+    """The process-wide governor (default sentinels on first use)."""
+    global _GOVERNOR
+    with _GOVERNOR_LOCK:
+        if _GOVERNOR is None:
+            _GOVERNOR = HealthGovernor()
+        return _GOVERNOR
+
+
+def configure(sentinels: list[Sentinel] | None = None,
+              clock=time.monotonic) -> HealthGovernor:
+    """Replace the process governor (tests / soak wiring)."""
+    global _GOVERNOR
+    with _GOVERNOR_LOCK:
+        _GOVERNOR = HealthGovernor(sentinels=sentinels, clock=clock)
+        return _GOVERNOR
+
+
+def current_state() -> int:
+    """The governor's last-checked state, without running sentinels —
+    O(1), safe on the per-event admission path. HEALTHY before any
+    governor exists."""
+    g = _GOVERNOR
+    return HEALTHY if g is None else g.state
+
+
+def check() -> int:
+    """Run the process governor's sentinels now."""
+    return governor().check()
+
+
+def note_slo(p99_ms: float, budget_ms: float) -> None:
+    """Feed an SLO report to the governor's breach sentinel — only if a
+    governor already exists (a serving run must not conjure one; state
+    only ever changes when someone runs :func:`check`)."""
+    g = _GOVERNOR
+    if g is not None:
+        g.note_slo(p99_ms, budget_ms)
+
+
+def health_report() -> dict:
+    """The process governor's last report (creates it if needed)."""
+    return governor().report()
+
+
+def reset() -> None:
+    """Drop the process governor (fresh lazy default on next use)."""
+    global _GOVERNOR
+    with _GOVERNOR_LOCK:
+        _GOVERNOR = None
+    HEALTH_STATE.set(HEALTHY)

@@ -1,12 +1,93 @@
 """Remote monitoring poster (reference: common/monitoring_api, 574 LoC
 — periodically POSTs beaconnode/validator process metrics JSON to a
-remote endpoint in the beaconcha.in client-stats format)."""
+remote endpoint in the beaconcha.in client-stats format) plus the
+psutil-free process self-observation the health governor feeds on:
+an RSS reader (``/proc/self/status`` VmRSS with a
+``resource.getrusage`` fallback) behind the
+``process_resident_memory_bytes`` gauge, and a jit-cache entry
+estimate behind ``bls_jit_cache_entries``."""
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.request
+
+from .metrics import REGISTRY
+
+RSS_BYTES = REGISTRY.gauge(
+    "process_resident_memory_bytes",
+    "Resident set size of this process (VmRSS; getrusage fallback)",
+)
+JIT_CACHE_ENTRIES = REGISTRY.gauge(
+    "bls_jit_cache_entries",
+    "Estimated live jit-cache entries (compiles since last counted clear)",
+)
+JIT_CACHE_CLEARS = REGISTRY.counter(
+    "bls_jit_cache_clears_total",
+    "Counted jax.clear_caches() invocations, by cause",
+    ("cause",),
+)
+
+
+def read_rss_bytes() -> int:
+    """Current RSS in bytes without psutil: ``/proc/self/status``
+    VmRSS (kB) where procfs exists, else ``resource.getrusage``
+    ru_maxrss (kB on Linux — a high-water mark, still monotone enough
+    for the leak sentinel). Returns 0 only if both fail."""
+    try:
+        with open("/proc/self/status", "rb") as fh:
+            for line in fh:
+                if line.startswith(b"VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+def sample_rss() -> int:
+    """Read RSS and mirror it into ``process_resident_memory_bytes``."""
+    rss = read_rss_bytes()
+    RSS_BYTES.set(rss)
+    return rss
+
+
+# Jit-cache entry accounting: JAX exposes no stable global cache-size
+# API, so we count compiles (jax_backend's jit-cache probe calls
+# note_jit_compile on every miss) and re-baseline on a counted clear.
+_JIT_LOCK = threading.Lock()
+_JIT_COMPILES = 0
+_JIT_BASELINE = 0
+
+
+def note_jit_compile(n: int = 1) -> None:
+    """A jit-cache miss (a compile) happened; bump the entry estimate."""
+    global _JIT_COMPILES
+    with _JIT_LOCK:
+        _JIT_COMPILES += n
+        JIT_CACHE_ENTRIES.set(_JIT_COMPILES - _JIT_BASELINE)
+
+
+def note_jit_cache_cleared(cause: str = "manual") -> None:
+    """The caches were dropped (jax.clear_caches / arena prune):
+    re-baseline the entry estimate and count the clear."""
+    global _JIT_BASELINE
+    with _JIT_LOCK:
+        _JIT_BASELINE = _JIT_COMPILES
+        JIT_CACHE_ENTRIES.set(0)
+    JIT_CACHE_CLEARS.inc(cause=cause)
+
+
+def jit_cache_entry_count() -> int:
+    """Estimated live jit-cache entries since the last counted clear."""
+    with _JIT_LOCK:
+        return _JIT_COMPILES - _JIT_BASELINE
 
 
 class MonitoringService:

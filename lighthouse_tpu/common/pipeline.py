@@ -129,6 +129,7 @@ class PipelineRun:
             PIPELINE_OVERLAP.inc(host_s)
         self.chunks_done += 1
         PIPELINE_CHUNKS.inc()
+        note_progress()
 
     def finish(self) -> dict:
         stages = {
@@ -158,6 +159,24 @@ class PipelineRun:
 
 _LAST_REPORT: dict = {"enabled": False, "chunks": 0, "overlap_s": 0.0}
 
+# Cross-thread dispatch-progress heartbeat: chunk completions stamp it,
+# the soak watchdog reads it to tell a *slow* slot (heartbeat fresh —
+# keep waiting) from a *wedged* one (heartbeat stale — force-degrade).
+_LAST_PROGRESS_T: float = 0.0
+
+
+def note_progress() -> None:
+    """Stamp the dispatch-progress heartbeat (monotonic wall clock)."""
+    global _LAST_PROGRESS_T
+    _LAST_PROGRESS_T = time.monotonic()
+
+
+def last_progress_age() -> float:
+    """Seconds since the last dispatch progress; inf if none yet."""
+    if _LAST_PROGRESS_T <= 0.0:
+        return float("inf")
+    return time.monotonic() - _LAST_PROGRESS_T
+
 
 def last_run_report() -> dict:
     """Snapshot of the most recent pipelined verify (stage report/bench)."""
@@ -165,5 +184,6 @@ def last_run_report() -> dict:
 
 
 def reset() -> None:
-    global _LAST_REPORT
+    global _LAST_REPORT, _LAST_PROGRESS_T
     _LAST_REPORT = {"enabled": False, "chunks": 0, "overlap_s": 0.0}
+    _LAST_PROGRESS_T = 0.0

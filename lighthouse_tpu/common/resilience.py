@@ -100,6 +100,12 @@ DEADLINE_TOTAL = REGISTRY.counter(
     "Deadline-bounded operations that hit their deadline",
     ("stage",),
 )
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "bls_breaker_transitions_total",
+    "Breaker state changes by rung and destination state "
+    "(the gauge only shows the latest state; flapping needs the counter)",
+    ("rung", "to"),
+)
 
 
 def enabled() -> bool:
@@ -323,6 +329,8 @@ class CircuitBreaker:
         return _STATE_NAMES[self._state]
 
     def _set(self, state: int) -> None:
+        if state != self._state:
+            BREAKER_TRANSITIONS.inc(rung=self.name, to=_STATE_NAMES[state])
         self._state = state
         BREAKER_STATE.set(state, path=self.name)
 
@@ -378,6 +386,12 @@ def breaker(path: str) -> CircuitBreaker:
 def breaker_states() -> dict[str, str]:
     """{rung: state-name} for every ladder rung (bench/report surface)."""
     return {path: breaker(path).state_name for path in LADDER}
+
+
+def breaker_transitions_total() -> float:
+    """Sum of ``bls_breaker_transitions_total`` over every rung/state —
+    the flap-rate sentinel and the soak's per-epoch delta read this."""
+    return sum(v for _, v in BREAKER_TRANSITIONS.items())
 
 
 # ------------------------------------------------------------ fault injection
@@ -486,6 +500,15 @@ def maybe_inject(stage: str) -> None:
     """Fire a pending injected fault for ``stage`` (production no-op
     unless ``LHTPU_FAULT_INJECT`` is set)."""
     _INJECTOR.fire(stage)
+
+
+def rearm_faults() -> None:
+    """Re-arm ``LHTPU_FAULT_INJECT`` counts WITHOUT touching breaker
+    state. The injector keeps exhausted counts while the spec string is
+    unchanged (so one drill matrix can run in-process); a soak that
+    schedules the same fault in consecutive epochs must re-arm at each
+    epoch boundary to get that epoch's fresh fault budget."""
+    _INJECTOR.reset()
 
 
 # ------------------------------------------------------------------ deadline

@@ -44,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .common import pipeline, resilience, tracing
+from .common import monitoring, pipeline, resilience, tracing
 from .common.logging import StructuredLogger
 from .common.metrics import REGISTRY
 from .crypto.bls.backends import register_backend
@@ -225,9 +225,10 @@ def _jit_cache_probe(fn, label: str):
             after = fn._cache_size()
         except Exception:
             return
-        JIT_CACHE_EVENTS.inc(
-            fn=label, event="miss" if after > before else "hit"
-        )
+        miss = after > before
+        JIT_CACHE_EVENTS.inc(fn=label, event="miss" if miss else "hit")
+        if miss:
+            monitoring.note_jit_compile(after - before)
 
     return done
 
@@ -262,7 +263,19 @@ def dispatch_stage_report() -> dict:
         "cache": _input_cache_report(),
         "triage": dict(_LAST_TRIAGE),
         "slo": _slo_last_report(),
+        "health": _health_report(),
     }
+
+
+def _health_report():
+    """Last governor report (lazy + guarded like the SLO hook: the
+    health module must stay optional to this module's import)."""
+    try:
+        from .common import health
+
+        return health.health_report()
+    except Exception:
+        return None
 
 
 def _slo_last_report():
@@ -1537,18 +1550,33 @@ class JaxBackend:
         elif not resilience.enabled():
             verdicts = self._triage_device(live)
         else:
-            try:
-                verdicts = self._triage_device(live)
-            except Exception as exc:
-                self._record_rung_failure(exc)
+            # Gate the device path on the primary rung's breaker: after
+            # a permanent fault opens it, triage degrades WITHOUT
+            # re-attempting until the cooldown admits a half-open probe
+            # — whose success here re-closes the breaker and re-promotes
+            # the serving path (the soak's recovery guarantee).
+            rung = self._ladder()[0]
+            br = resilience.breaker(rung)
+            if not br.allow():
                 resilience.DEGRADED_TOTAL.inc(path="triage-host-bisect")
-                _LOG.warn(
-                    "poison triage degraded to host bisection",
-                    cause=str(exc)[:200],
-                )
                 verdicts = self._triage_host_bisect(
-                    live, reason=f"degraded: {type(exc).__name__}"
+                    live, reason="breaker-open"
                 )
+            else:
+                try:
+                    verdicts = self._triage_device(live)
+                except Exception as exc:
+                    self._record_rung_failure(exc, rung=rung)
+                    resilience.DEGRADED_TOTAL.inc(path="triage-host-bisect")
+                    _LOG.warn(
+                        "poison triage degraded to host bisection",
+                        cause=str(exc)[:200],
+                    )
+                    verdicts = self._triage_host_bisect(
+                        live, reason=f"degraded: {type(exc).__name__}"
+                    )
+                else:
+                    br.record_success()
         for i, v in zip(live_idx, verdicts):
             out[i] = bool(v)
         return out

@@ -13,7 +13,13 @@ harness and serve it to an SLO"):
   work type, exported to the metrics registry and to
   ``jax_backend.dispatch_stage_report()["slo"]`` / the ``/slo``
   endpoint / ``bench.py --slot-load``.
+* ``soak``     — multi-epoch endurance runs over ``serve`` (ISSUE 7 /
+  ROADMAP "soak subsystem"): deterministic chaos schedules
+  (``LHTPU_CHAOS_SCHEDULE``) layered on the fault injector, leak
+  sentinels + the ``common/health`` governor sampled per epoch, a
+  wedge watchdog, re-promotion scoring and chaos-free digest-parity
+  replay. CLI: ``tools/soak.py``.
 
-Only ``slo`` is import-light; import ``traffic``/``serve`` explicitly
-(they pull in the crypto and network layers).
+Only ``slo`` is import-light; import ``traffic``/``serve``/``soak``
+explicitly (they pull in the crypto and network layers).
 """

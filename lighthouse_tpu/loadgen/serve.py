@@ -34,6 +34,7 @@ import os
 import time
 from dataclasses import dataclass
 
+from ..common import health, pipeline
 from ..crypto.bls import api as bls_api
 from ..network.processor import BATCHED, BeaconProcessor, WorkEvent, WorkType
 from . import slo
@@ -170,25 +171,45 @@ class ServingLoop:
         self.events_offered = 0
         self.events_admitted = 0
         self.shed_by_type: dict[str, int] = {}
+        self.force_degraded_by_type: dict[str, int] = {}
         self._admission_open = True
         self._admission_engaged = False
         self._transitions = 0
         self._dropped_base = dict(self.processor.dropped())
         self._batches_base = self.processor.batches_dispatched
+        # Watchdog surface: the handler currently executing (set by the
+        # instrumentation wrappers) and a generation counter that lets a
+        # late-waking wedged handler know its batch was already
+        # force-degraded (so it must not also record it as served).
+        self._inflight: list[WorkEvent] = []
+        self._watchdog_gen = 0
+        self.watchdog_fired = 0
         slo.ADMISSION_OPEN.set(1)
 
     # ------------------------------------------------------ instrumentation
     def _instrument(self, handler, wt: WorkType, batched: bool):
         if batched:
             def wrapped(events: list[WorkEvent]):
+                gen = self._watchdog_gen
+                self._inflight = list(events)
                 handler(events)
+                pipeline.note_progress()
+                if gen != self._watchdog_gen:
+                    return  # force-degraded while wedged; not served
+                self._inflight = []
                 t1 = self.clock.now()
                 for ev in events:
                     t0 = getattr(ev, "_loadgen_enqueue_t", t1)
                     self.recorder.observe(wt.value, max(0.0, t1 - t0))
         else:
             def wrapped(ev: WorkEvent):
+                gen = self._watchdog_gen
+                self._inflight = [ev]
                 handler(ev)
+                pipeline.note_progress()
+                if gen != self._watchdog_gen:
+                    return
+                self._inflight = []
                 t1 = self.clock.now()
                 t0 = getattr(ev, "_loadgen_enqueue_t", t1)
                 self.recorder.observe(wt.value, max(0.0, t1 - t0))
@@ -210,15 +231,31 @@ class ServingLoop:
     def _sheddable_depth(self) -> int:
         return sum(len(self.processor.queues[wt]) for wt in SHEDDABLE)
 
+    def _admission_limits(self) -> tuple[int, int]:
+        """(admit_high, admit_low) scaled by governor health: degraded
+        halves the close watermark, critical quarters it — the loop
+        sheds earlier while the process is eroding. Reads the governor's
+        LAST-CHECKED state (O(1)); nobody running ``health.check()``
+        means stock watermarks."""
+        high, low = self.cfg.admit_high, self.cfg.admit_low
+        state = health.current_state()
+        if state >= health.CRITICAL:
+            high = high // 4
+        elif state >= health.DEGRADED:
+            high = high // 2
+        high = max(high, 1)
+        return high, min(low, high - 1)
+
     def _admission_check(self) -> None:
         depth = self._sheddable_depth()
-        if self._admission_open and depth >= self.cfg.admit_high:
+        admit_high, admit_low = self._admission_limits()
+        if self._admission_open and depth >= admit_high:
             self._admission_open = False
             self._admission_engaged = True
             self._transitions += 1
             slo.ADMISSION_OPEN.set(0)
             slo.ADMISSION_TRANSITIONS.inc(state="closed")
-        elif not self._admission_open and depth <= self.cfg.admit_low:
+        elif not self._admission_open and depth <= admit_low:
             self._admission_open = True
             self._transitions += 1
             slo.ADMISSION_OPEN.set(1)
@@ -283,6 +320,29 @@ class ServingLoop:
         self._drain_remaining()
         return self.finish()
 
+    # ------------------------------------------------------------ watchdog
+    def watchdog_force_degrade(self, reason: str = "wedged") -> int:
+        """Force-degrade every pending event — the in-flight handler's
+        batch plus everything still queued — instead of letting a
+        wedged slot hang the loop. Safe to call from a thread other
+        than the one stuck inside the handler: bumping the generation
+        counter tells a late-waking handler its batch was reassigned,
+        so ``served``/``force_degraded`` stay disjoint. Returns the
+        number of events force-degraded."""
+        self.watchdog_fired += 1
+        self._watchdog_gen += 1
+        slo.WATCHDOG_FIRED.inc()
+        pending = list(self._inflight)
+        self._inflight = []
+        pending.extend(self.processor.flush())
+        for ev in pending:
+            wt = ev.work_type.value
+            self.force_degraded_by_type[wt] = (
+                self.force_degraded_by_type.get(wt, 0) + 1
+            )
+            slo.WATCHDOG_FORCED.inc(work_type=wt)
+        return len(pending)
+
     # -------------------------------------------------------------- report
     def finish(self) -> dict:
         lat = self.recorder.summary()
@@ -295,6 +355,15 @@ class ServingLoop:
             if v - self._dropped_base.get(k, 0) > 0
         }
         dropped = sum(dropped_by_type.values())
+        force_degraded = sum(self.force_degraded_by_type.values())
+        served = self.recorder.count()
+        # Disjoint-outcome identity: everything offered was served, shed
+        # at admission, dropped by a full queue, force-degraded by the
+        # watchdog, or is still pending — each event in exactly one
+        # bucket (the watchdog generation counter keeps a late-waking
+        # wedged handler from double-counting its batch as served).
+        pending = self.processor.pending() + len(self._inflight)
+        accounted = served + shed + dropped + force_degraded + pending
         report = {
             "slo": {
                 "p50_ms": overall["p50_ms"],
@@ -311,9 +380,21 @@ class ServingLoop:
             "latency_ms": lat,
             "events_offered": self.events_offered,
             "events_admitted": self.events_admitted,
-            "events_served": self.recorder.count(),
+            "events_served": served,
             "shed_by_type": dict(self.shed_by_type),
             "dropped_by_type": dropped_by_type,
+            "force_degraded_by_type": dict(self.force_degraded_by_type),
+            "force_degraded": force_degraded,
+            "watchdog": {"fired": self.watchdog_fired},
+            "accounting": {
+                "served": served,
+                "shed": shed,
+                "dropped": dropped,
+                "force_degraded": force_degraded,
+                "pending": pending,
+                "balanced": accounted == self.events_offered,
+            },
+            "health": health.health_report() if health._GOVERNOR else None,
             "verdicts": {
                 "served": len(self.verdicts),
                 "valid": sum(1 for v in self.verdicts.values() if v),
@@ -327,6 +408,7 @@ class ServingLoop:
             },
             "batches": self.processor.batches_dispatched - self._batches_base,
         }
+        health.note_slo(overall["p99_ms"], self.cfg.slo_budget_ms)
         slo.set_last_report(report)
         return report
 

@@ -51,6 +51,15 @@ VERDICT_MISMATCHES = REGISTRY.counter(
     "loadgen_verdict_mismatch_total",
     "Served verdicts disagreeing with the traffic generator's ground truth",
 )
+WATCHDOG_FIRED = REGISTRY.counter(
+    "loadgen_watchdog_fired_total",
+    "Serving-loop watchdog activations (a slot wedged past its budget)",
+)
+WATCHDOG_FORCED = REGISTRY.counter(
+    "loadgen_watchdog_force_degraded_total",
+    "Pending work events force-degraded by the watchdog instead of served",
+    ("work_type",),
+)
 
 
 def quantile(sorted_samples: list[float], q: float) -> float:

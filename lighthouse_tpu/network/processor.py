@@ -354,6 +354,21 @@ class BeaconProcessor:
             return 1
         return 0
 
+    def flush(self) -> list[WorkEvent]:
+        """Evacuate every queue WITHOUT dispatching: returns all queued
+        events in drain-priority order and zeroes the depth gauges. The
+        serving-loop watchdog uses this to force-degrade pending work
+        when a slot wedges — the events are accounted by the caller,
+        never handled."""
+        out: list[WorkEvent] = []
+        for wt in DRAIN_ORDER:
+            q = self.queues[wt]
+            out.extend(q.items)
+            q.items.clear()
+            q.times.clear()
+            QUEUE_DEPTH.set(0, work_type=q.kind)
+        return out
+
     def process_pending(self, max_events: int | None = None) -> int:
         """Drain until idle (or ``max_events``); the deterministic
         equivalent of the reference's manager + worker-pool loop."""

@@ -144,6 +144,15 @@ def _reset_resilience_state():
     mod = _sys.modules.get("lighthouse_tpu.common.resilience")
     if mod is not None:
         mod.reset()
+    # Same hygiene for the health governor (a DEGRADED governor left by
+    # one test would shrink every later test's admission watermarks)
+    # and the dispatch heartbeat the soak watchdog reads.
+    hmod = _sys.modules.get("lighthouse_tpu.common.health")
+    if hmod is not None:
+        hmod.reset()
+    pmod = _sys.modules.get("lighthouse_tpu.common.pipeline")
+    if pmod is not None and hasattr(pmod, "note_progress"):
+        pmod._LAST_PROGRESS_T = 0.0
 
 
 @pytest.fixture

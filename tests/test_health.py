@@ -1,0 +1,271 @@
+"""Health governor unit tests (ISSUE 7): every sentinel driven on a
+virtual clock with injectable probes, governor max/transition logic,
+the breaker-transition counter, the psutil-free RSS/jit-cache plumbing
+in common/monitoring.py, and health-aware admission in the serving
+loop. No JAX dispatch anywhere — these are pure state-machine tests."""
+
+import pytest
+
+from lighthouse_tpu.common import health, monitoring, resilience
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------------------- sentinels
+def test_rss_growth_sentinel_windows():
+    rss = {"v": 100 * 2**20}
+    s = health.RssGrowthSentinel(
+        window_s=10.0, growth_mb=1.0, critical_mb=1000.0,
+        read_rss=lambda: rss["v"],
+    )
+    level, _ = s.check(0.0)
+    assert level == health.HEALTHY  # first sample is its own baseline
+
+    rss["v"] += 2 * 2**20  # +2 MB inside the window
+    level, detail = s.check(5.0)
+    assert level == health.DEGRADED
+    assert detail["window_growth_mb"] == pytest.approx(2.0)
+
+    # window slides past the old baseline: flat RSS is healthy again
+    level, _ = s.check(20.0)
+    assert level == health.HEALTHY
+
+    rss["v"] = 2000 * 2**20  # absolute ceiling, not slope
+    level, _ = s.check(21.0)
+    assert level == health.CRITICAL
+
+
+def test_jit_cache_sentinel_counted_clear_once_per_crossing():
+    entries = {"v": 0}
+    clears = []
+
+    def clear():
+        clears.append(entries["v"])
+        entries["v"] = 0  # an effective clear re-baselines
+
+    s = health.JitCacheSentinel(
+        max_entries=4, entries_fn=lambda: entries["v"], clear_fn=clear,
+    )
+    level, _ = s.check(0.0)
+    assert level == health.HEALTHY and not clears
+
+    entries["v"] = 9  # crossing fires exactly one counted clear
+    level, detail = s.check(1.0)
+    assert clears == [9] and s.clears == 1
+    assert detail["cleared_now"] is True
+    assert level == health.HEALTHY  # re-read after the clear: back below
+
+    entries["v"] = 3  # below watermark: re-arms, no clear
+    s.check(2.0)
+    entries["v"] = 7  # second crossing -> second clear, not before
+    s.check(3.0)
+    assert s.clears == 2 and clears == [9, 7]
+
+
+def test_jit_cache_sentinel_ineffective_clear_stays_degraded():
+    entries = {"v": 9}
+    calls = []
+    s = health.JitCacheSentinel(
+        max_entries=4, entries_fn=lambda: entries["v"],
+        clear_fn=lambda: calls.append(1),  # does NOT shrink the cache
+    )
+    level, _ = s.check(0.0)
+    assert level == health.DEGRADED and len(calls) == 1
+    level, _ = s.check(1.0)
+    assert level == health.DEGRADED and len(calls) == 1  # disarmed: no spam
+
+
+def test_cache_hit_rate_sentinel_windowed_collapse():
+    stats = {"pubkey_rows": {"hit": 0, "miss": 0}}
+    s = health.CacheHitRateSentinel(
+        floor=0.5, min_samples=10, report_fn=lambda: stats,
+    )
+    stats["pubkey_rows"] = {"hit": 18, "miss": 2}  # 90% over 20 lookups
+    level, _ = s.check(0.0)
+    assert level == health.HEALTHY
+
+    stats["pubkey_rows"] = {"hit": 18, "miss": 22}  # window: 0/20
+    level, detail = s.check(1.0)
+    assert level == health.DEGRADED
+    assert detail["pubkey_rows"]["window_hit_rate"] == 0.0
+
+    stats["pubkey_rows"] = {"hit": 19, "miss": 22}  # only 1 new lookup
+    level, detail = s.check(2.0)
+    assert level == health.HEALTHY  # under min_samples: no judgment
+    assert detail["pubkey_rows"] == {"window_lookups": 1}
+
+
+def test_breaker_flap_sentinel_rate_and_open_rung():
+    total = {"v": 0.0}
+    states = {"v": {"classic": "closed"}}
+    s = health.BreakerFlapSentinel(
+        window_s=10.0, max_flaps=2,
+        transitions_fn=lambda: total["v"], states_fn=lambda: states["v"],
+    )
+    assert s.check(0.0)[0] == health.HEALTHY
+    total["v"] = 5.0  # 5 transitions inside the window
+    assert s.check(1.0)[0] == health.DEGRADED
+    assert s.check(20.0)[0] == health.HEALTHY  # window slid past the burst
+    states["v"] = {"classic": "open"}  # actively re-routing rung
+    assert s.check(21.0)[0] == health.DEGRADED
+
+
+def test_slo_breach_sentinel_streaks():
+    s = health.SloBreachSentinel(streak=2)
+    assert s.check(0.0)[0] == health.HEALTHY
+    s.note(10.0, budget_ms=5.0)
+    assert s.check(1.0)[0] == health.HEALTHY  # one breach, not a streak
+    s.note(10.0, budget_ms=5.0)
+    assert s.check(2.0)[0] == health.DEGRADED
+    s.note(10.0, budget_ms=5.0)
+    s.note(10.0, budget_ms=5.0)
+    assert s.check(3.0)[0] == health.CRITICAL  # 2*streak
+    s.note(1.0, budget_ms=5.0)  # within budget: streak resets
+    assert s.check(4.0)[0] == health.HEALTHY
+
+
+# ---------------------------------------------------------------- governor
+class _Pinned(health.Sentinel):
+    name = "pinned"
+
+    def __init__(self, level):
+        self.level = level
+
+    def check(self, now):
+        return self.level, {}
+
+
+class _Broken(health.Sentinel):
+    name = "broken"
+
+    def check(self, now):
+        raise RuntimeError("probe exploded")
+
+
+def test_governor_max_over_sentinels_and_broken_probe():
+    clk = FakeClock()
+    g = health.HealthGovernor(
+        sentinels=[_Pinned(health.DEGRADED), _Broken()], clock=clk,
+    )
+    before = health.HEALTH_TRANSITIONS.value(to="degraded")
+    assert g.check() == health.DEGRADED
+    assert health.HEALTH_TRANSITIONS.value(to="degraded") == before + 1
+    rep = g.report()
+    assert rep["state"] == "degraded" and rep["ready"] is True
+    # a broken probe is reported, never treated as critical
+    assert "error" in rep["sentinels"]["broken"]
+
+    g.sentinels[0].level = health.CRITICAL
+    assert g.check() == health.CRITICAL
+    assert g.report()["ready"] is False
+    g.sentinels[0].level = health.HEALTHY
+    assert g.check() == health.HEALTHY
+    assert g.report()["ready"] is True
+
+
+def test_note_slo_never_conjures_a_governor():
+    health.reset()
+    health.note_slo(9999.0, 1.0)
+    assert health._GOVERNOR is None  # serving runs must not create one
+    assert health.current_state() == health.HEALTHY
+    # but it feeds a governor that already exists
+    g = health.configure(sentinels=[health.SloBreachSentinel(streak=1)])
+    health.note_slo(9999.0, 1.0)
+    assert g.check() == health.DEGRADED
+
+
+# ----------------------------------------------- breaker transition counter
+def test_breaker_transitions_counter_by_rung_and_state(monkeypatch):
+    monkeypatch.setenv("LHTPU_BREAKER_COOLDOWN_S", "0")
+    resilience.reset()
+    v0 = {
+        to: resilience.BREAKER_TRANSITIONS.value(rung="classic", to=to)
+        for to in ("open", "half-open", "closed")
+    }
+    t0 = resilience.breaker_transitions_total()
+    br = resilience.breaker("classic")
+    br.record_failure(permanent=True)   # closed -> open
+    assert br.allow()                   # open -> half-open (cooldown 0)
+    br.record_success()                 # half-open -> closed
+    for to in ("open", "half-open", "closed"):
+        assert resilience.BREAKER_TRANSITIONS.value(
+            rung="classic", to=to
+        ) == v0[to] + 1
+    assert resilience.breaker_transitions_total() == t0 + 3
+    # steady-state success does not count as a transition
+    br.record_success()
+    assert resilience.breaker_transitions_total() == t0 + 3
+
+
+# --------------------------------------------------------------- monitoring
+def test_read_rss_bytes_psutil_free():
+    rss = monitoring.read_rss_bytes()
+    assert rss > 0  # /proc/self/status VmRSS (or getrusage fallback)
+    assert monitoring.sample_rss() == monitoring.RSS_BYTES.value()
+
+
+def test_jit_cache_entry_estimate_roundtrip():
+    base = monitoring.jit_cache_entry_count()
+    monitoring.note_jit_compile(3)
+    assert monitoring.jit_cache_entry_count() == base + 3
+    before = monitoring.JIT_CACHE_CLEARS.value(cause="test")
+    monitoring.note_jit_cache_cleared(cause="test")
+    assert monitoring.jit_cache_entry_count() == 0
+    assert monitoring.JIT_CACHE_CLEARS.value(cause="test") == before + 1
+    assert monitoring.JIT_CACHE_ENTRIES.value() == 0
+
+
+# ------------------------------------------------- health-aware admission
+def test_admission_watermarks_scale_with_health():
+    from lighthouse_tpu.loadgen.serve import ServeConfig, ServingLoop, \
+        VirtualClock
+
+    loop = ServingLoop(
+        ServeConfig(batch_target=4, admit_high=8, admit_low=4),
+        clock=VirtualClock(), verify=lambda sets: [True] * len(sets),
+    )
+    assert loop._admission_limits() == (8, 4)  # no governor: stock
+
+    g = health.configure(sentinels=[_Pinned(health.DEGRADED)])
+    g.check()
+    assert loop._admission_limits() == (4, 3)  # degraded halves the gate
+
+    g.sentinels[0].level = health.CRITICAL
+    g.check()
+    assert loop._admission_limits() == (2, 1)  # critical quarters it
+
+    g.sentinels[0].level = health.HEALTHY
+    g.check()
+    assert loop._admission_limits() == (8, 4)
+
+
+def test_serving_loop_feeds_slo_sentinel():
+    from lighthouse_tpu.loadgen.serve import ServeConfig, ServingLoop, \
+        VirtualClock
+    from lighthouse_tpu.loadgen.traffic import TrafficConfig, \
+        TrafficGenerator
+
+    g = health.configure(sentinels=[health.SloBreachSentinel(streak=1)])
+    events = TrafficGenerator(TrafficConfig(
+        validators=16, slots=1, seconds_per_slot=1.0,
+        committees_per_slot=1, committee_size=2,
+        unaggregated_per_slot=0, sync_per_slot=0, blocks=False,
+        poison_rate=0.0, key_pool=4, seed=3,
+    )).generate()
+    # batch_target > stream size: every batch waits out the deadline, so
+    # p99 ~ 50 ms >> the absurd 0.001 ms budget -> one breach report.
+    loop = ServingLoop(
+        ServeConfig(batch_target=64, batch_deadline_ms=50.0,
+                    slo_budget_ms=0.001),
+        clock=VirtualClock(), verify=lambda sets: [True] * len(sets),
+    )
+    report = loop.run(events)
+    assert report["events_served"] > 0
+    assert g.check() == health.DEGRADED
+    assert report["health"] is not None  # finish() surfaces the governor

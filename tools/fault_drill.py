@@ -378,6 +378,126 @@ def run_drill_slot_load(kinds=KINDS, backend=None):
     return results
 
 
+def run_drill_soak():
+    """Multi-epoch soak drill (ISSUE 7): two endurance cells over
+    ``loadgen/soak.SoakRunner`` on the virtual clock, aggregate-only
+    traffic pinned to the (S=2, K=2, G=2) bucket the other rows pay
+    for (batch_target=2 with a deadline past within-slot jitter, so
+    per-epoch seed shifts can never form an odd-sized batch that would
+    need a fresh device program mid-soak).
+
+    * ``transient mid-soak``: one ``dispatch:remote_compile`` fault at
+      epoch 1 of 3 — the run must PASS, re-promote to the primary rung
+      within the recovery budget, and its per-epoch verdict digests
+      must match the chaos-free replay bit-for-bit.
+    * ``permanent sustained``: ``dispatch:mosaic`` at epochs 1 and 2 of
+      3 — the run must end DEGRADED (breakers open, host bisection
+      serving), never crash, and still keep every verdict correct."""
+    from lighthouse_tpu.common import health, resilience
+    from lighthouse_tpu.loadgen.serve import ServeConfig
+    from lighthouse_tpu.loadgen.soak import ChaosEvent, SoakConfig, SoakRunner
+    from lighthouse_tpu.loadgen.traffic import TrafficConfig
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS",
+                  "LHTPU_BREAKER_COOLDOWN_S")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    # breakers must half-open inside the drill's wall time
+    os.environ["LHTPU_BREAKER_COOLDOWN_S"] = "0.01"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    # Deterministic sentinels only: the RSS/jit-cache sentinels react to
+    # unrelated compile activity earlier in the drill matrix.
+    health.configure(sentinels=[
+        health.BreakerFlapSentinel(), health.SloBreachSentinel(),
+    ])
+
+    def _cfg(replay: bool) -> SoakConfig:
+        return SoakConfig(
+            epochs=3, seed=7, backend="jax", recovery_epochs=2,
+            replay=replay,
+            traffic=TrafficConfig(
+                validators=64, slots=2, seconds_per_slot=2.0,
+                committees_per_slot=2, committee_size=2,
+                unaggregated_per_slot=0, sync_per_slot=0, blocks=False,
+                poison_rate=0.25, key_pool=8, seed=7,
+            ),
+            serve=ServeConfig(batch_target=2, batch_deadline_ms=1000.0),
+        )
+
+    cells = (
+        ("remote_compile", "transient",
+         [ChaosEvent(epoch=1, stage="dispatch",
+                     kind="remote_compile", count=1)], True),
+        ("mosaic", "permanent",
+         [ChaosEvent(epoch=e, stage="dispatch", kind="mosaic", count=1)
+          for e in (1, 2)], False),
+    )
+    results = []
+    try:
+        for kind, category, chaos, replay in cells:
+            resilience.reset()
+            retries0 = _total(resilience.RETRIES_TOTAL)
+            degraded0 = _total(resilience.DEGRADED_TOTAL)
+            error = None
+            res = None
+            try:
+                res = SoakRunner(_cfg(replay), chaos=chaos, emit=None).run()
+            except Exception as exc:  # contract breach, not a crash
+                error = f"{type(exc).__name__}: {exc}"
+            retries = _total(resilience.RETRIES_TOTAL) - retries0
+            degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+            if res is None:
+                ok = False
+            elif category == "transient":
+                # chaos absorbed: verdict passes end-to-end, the ladder
+                # re-promotes, and the replay digests are bit-identical
+                ok = (res["verdict"] == "pass"
+                      and res["mismatches_total"] == 0
+                      and res["repromotion"]["required"]
+                      and res["repromotion"]["ok"]
+                      and res["replay"]["digests_match"] is True)
+            else:
+                # sustained permanent: degrade (both chaos epochs), keep
+                # verdicts exact, never crash or wedge
+                ok = (res is not None
+                      and not any(r.startswith("crashed")
+                                  for r in res["reasons"])
+                      and res["mismatches_total"] == 0
+                      and res["degraded_epochs"] >= 2
+                      and res["degraded_time_fraction"] < 1.0
+                      and res["watchdog_fired"] == 0)
+            results.append({
+                "mode": "soak",
+                "stage": "dispatch",
+                "kind": kind,
+                "category": category,
+                "verdict": (res["mismatches_total"] == 0
+                            if res is not None else None),
+                "retries": retries,
+                "degraded": degraded,
+                "path": None if res is None else f"soak:{res['verdict']}",
+                "healthy_path": None,
+                "degraded_time_fraction":
+                    res["degraded_time_fraction"] if res else None,
+                "error": error,
+                "ok": ok,
+            })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+        health.reset()
+    return results
+
+
 def main() -> int:
     json_mode = "--json" in sys.argv
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
@@ -387,7 +507,7 @@ def main() -> int:
 
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS)}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -399,6 +519,9 @@ def main() -> int:
     # Serving-loop matrix (ISSUE 6): transients injected mid-slot into
     # a loadgen poison-storm replay — degrade, never crash.
     results += run_drill_slot_load()
+    # Soak matrix (ISSUE 7): multi-epoch chaos → re-promotion + digest
+    # parity; sustained permanents degrade, never crash.
+    results += run_drill_soak()
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "
