@@ -60,12 +60,31 @@ def min_sets() -> int:
 
 
 def chunk_size(n: int) -> int:
-    """Power-of-two chunk size for an n-set batch."""
+    """Power-of-two chunk size for an n-set batch.
+
+    Shard-aware (ISSUE 8): when the dispatch engine would lay chunks
+    over a multi-chip mesh, the default is floored so every chunk still
+    gives each chip at least its min-sets-per-chip share — otherwise
+    chunking would push every microbatch under the sharding threshold
+    and silently serialize the mesh. An explicit
+    ``LHTPU_PIPELINE_CHUNK`` always wins (tests pin exact chunk
+    geometries with it).
+    """
     raw = os.environ.get("LHTPU_PIPELINE_CHUNK", "")
     try:
         return max(2, next_pow2(int(raw)))
     except ValueError:
-        return max(MIN_CHUNK, next_pow2(n) // 4)
+        pass
+    base = max(MIN_CHUNK, next_pow2(n) // 4)
+    try:
+        from ..parallel import engine
+
+        floor = engine.chunk_floor()
+    except Exception:
+        floor = 1
+    if floor > 1:
+        base = max(base, next_pow2(floor))
+    return base
 
 
 def should_pipeline(n: int) -> bool:

@@ -137,6 +137,12 @@ _PERMANENT_PATTERNS: tuple[tuple[str, str], ...] = (
     ("resource_exhausted", "oom"),
     ("resource exhausted", "oom"),
     ("out of memory", "oom"),
+    # A chip dropping out of the mesh mid-serve: the sharded program is
+    # unrunnable until the mesh is rebuilt — permanent for THIS topology
+    # (the sharded breaker degrades dispatch to single-chip; half-open
+    # re-promotion probes the mesh after the cooldown).
+    ("device lost", "chip_loss"),
+    ("chip removed from mesh", "chip_loss"),
 )
 _TRANSIENT_PATTERNS: tuple[tuple[str, str], ...] = (
     ("remote_compile", "remote_compile"),        # r05
@@ -368,6 +374,15 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._set(OPEN)
 
+    def release(self) -> None:
+        """Return an admitted-but-unused call slot: a half-open probe
+        that its caller decided not to dispatch after all (e.g. the
+        sharded planner admitted a dispatch whose retained packs turn
+        out not to divide the mesh) must not wedge the breaker
+        half-open with a phantom in-flight probe."""
+        with self._lock:
+            self._probing = False
+
 
 _BREAKERS: dict[str, CircuitBreaker] = {}
 _BREAKERS_LOCK = threading.Lock()
@@ -384,8 +399,14 @@ def breaker(path: str) -> CircuitBreaker:
 
 
 def breaker_states() -> dict[str, str]:
-    """{rung: state-name} for every ladder rung (bench/report surface)."""
-    return {path: breaker(path).state_name for path in LADDER}
+    """{rung: state-name} for every ladder rung plus any extra breakers
+    created on demand (e.g. the dispatch engine's "sharded" breaker —
+    bench/report surface)."""
+    with _BREAKERS_LOCK:
+        extra = [p for p in _BREAKERS if p not in LADDER]
+    return {
+        path: breaker(path).state_name for path in (*LADDER, *extra)
+    }
 
 
 def breaker_transitions_total() -> float:
@@ -422,6 +443,10 @@ _FAULT_FACTORIES = {
         "incompatible shapes for dispatch operands [injected]"
     ),
     "assert": lambda: AssertionError("injected correctness assert"),
+    "chip_loss": lambda: RuntimeError(
+        "INTERNAL: Device lost: TPU chip removed from mesh "
+        "(interconnect failure) [injected]"
+    ),
 }
 
 

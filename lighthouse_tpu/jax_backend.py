@@ -67,6 +67,7 @@ from .ops.points import (
 )
 from .ops.pairing import fp12_tree_prod, fp12_tree_prod_groups
 from .ops.tower import fp12_is_one, fp12_mul
+from .parallel import engine as parallel_engine
 
 
 from .utils import next_pow2 as _next_pow2
@@ -259,6 +260,7 @@ def dispatch_stage_report() -> dict:
         },
         "breaker": resilience.breaker_states(),
         "path": _LAST_PATH,
+        "parallel": parallel_engine.parallel_report(),
         "pipeline": pipeline.last_run_report(),
         "cache": _input_cache_report(),
         "triage": dict(_LAST_TRIAGE),
@@ -916,52 +918,20 @@ _verify_fused_indexed_grouped_jit = jax.jit(
     static_argnames=("n_groups",),
 )
 
-# Sharded fused programs keyed by (device count, indexed): built lazily
-# when more than one chip is visible.
-_SHARDED_FUSED: dict = {}
-# Sharded grouped-verdict programs keyed by (device count, group count,
-# indexed) — triage's multichip route.
-_SHARDED_GROUPED: dict = {}
-
-
+# Sharded program construction + caching lives in parallel/engine.py
+# (ISSUE 8); these thin delegates keep the historical call sites.
 def _sharded_fused_grouped_fn(n_dev: int, n_groups: int,
                               indexed: bool = False):
-    key = (n_dev, n_groups, indexed)
-    if key not in _SHARDED_GROUPED:
-        from .parallel import (
-            build_sharded_fused_grouped_indexed_verifier,
-            build_sharded_fused_grouped_verifier,
-            make_mesh,
-        )
-
-        mesh = make_mesh(n_dev, mp=1)
-        build = (
-            build_sharded_fused_grouped_indexed_verifier
-            if indexed
-            else build_sharded_fused_grouped_verifier
-        )
-        _SHARDED_GROUPED[key] = jax.jit(build(mesh, n_groups))
-    return _SHARDED_GROUPED[key]
+    return parallel_engine.sharded_grouped_fn(
+        n_dev, n_groups, fused=True, indexed=indexed
+    )
 
 
 def _sharded_fused_fn(n_dev: int, indexed: bool = False,
                       with_msm: bool = False):
-    key = (n_dev, indexed, with_msm)
-    if key not in _SHARDED_FUSED:
-        from .parallel import (
-            build_sharded_fused_indexed_verifier,
-            build_sharded_fused_verifier,
-            make_mesh,
-        )
-
-        mesh = make_mesh(n_dev, mp=1)
-        build = (
-            build_sharded_fused_indexed_verifier
-            if indexed
-            else build_sharded_fused_verifier
-        )
-        _SHARDED_FUSED[key] = jax.jit(build(mesh, with_msm=with_msm))
-    return _SHARDED_FUSED[key]
+    return parallel_engine.sharded_verify_fn(
+        n_dev, fused=True, indexed=indexed, with_msm=with_msm
+    )
 
 
 def _rand_scalars(n: int) -> tuple[np.ndarray, np.ndarray]:
@@ -1656,21 +1626,24 @@ class JaxBackend:
         host-fallback legs. Sharding additionally requires whole groups
         per chip (n_groups and S divisible by the device count)."""
         choice = _fused_choice()
-        self._last_rung = "fused" if choice == "1" else "classic"
-        n_dev = len(jax.devices())
-        shard = os.environ.get("LHTPU_SHARDED_VERIFY")
-        use_sharded = (
-            choice == "1"
-            and (
-                shard == "1"
-                or (shard is None and n_dev > 1
-                    and jax.default_backend() == "tpu")
+        fused = choice == "1"
+        self._last_rung = "fused" if fused else "classic"
+        # Device-count routing. Grouped dispatches reuse RETAINED packs
+        # (that is the point of triage refinement), so sharding
+        # additionally requires the packed S to already divide the mesh
+        # into power-of-two slices — refinement rounds whose sliced S
+        # falls under the device count re-dispatch single-chip rather
+        # than re-pack.
+        plan = parallel_engine.plan(pk.n, pk.S, n_groups=n_groups)
+        use_sharded = plan.devices > 1 and plan.S == pk.S
+        if not use_sharded and plan.devices > 1:
+            parallel_engine.release_probe()
+            plan = parallel_engine.ShardPlan(
+                1, pk.S, pk.S - pk.n, "pack-indivisible"
             )
-            and pk.S % n_dev == 0
-            and n_groups % n_dev == 0
-        )
+        n_dev = plan.devices
 
-        def run():
+        def run(sharded: bool):
             tail = (
                 jnp.asarray(pk.sx), jnp.asarray(pk.sy), jnp.asarray(pk.sinf),
                 jnp.asarray(pk.mx), jnp.asarray(pk.my), jnp.asarray(pk.minf),
@@ -1678,15 +1651,18 @@ class JaxBackend:
             )
             if pk.tx is not None:
                 idx, pinf = jnp.asarray(pk.idx), jnp.asarray(pk.pinf)
-                if use_sharded:
-                    fn = _sharded_fused_grouped_fn(
-                        n_dev, n_groups, indexed=True
+                if sharded:
+                    resilience.maybe_inject("sharded_dispatch")
+                    fn = parallel_engine.sharded_grouped_fn(
+                        n_dev, n_groups, fused=fused, indexed=True
                     )
-                    probe = _jit_cache_probe(fn, "sharded-indexed+triage")
+                    label = ("sharded-indexed+triage" if fused
+                             else "sharded-classic-indexed+triage")
+                    probe = _jit_cache_probe(fn, label)
                     ok = fn(pk.tx, pk.ty, idx, pinf, *tail)
-                    self.last_path = "sharded-indexed+triage"
+                    self.last_path = label
                 else:
-                    fn = (_verify_fused_indexed_grouped_jit if choice == "1"
+                    fn = (_verify_fused_indexed_grouped_jit if fused
                           else _verify_indexed_grouped_jit)
                     probe = _jit_cache_probe(fn, "indexed+triage")
                     ok = fn(
@@ -1696,18 +1672,23 @@ class JaxBackend:
                         n_groups=n_groups,
                     )
                     self.last_path = "indexed+triage"
-            elif use_sharded:
-                fn = _sharded_fused_grouped_fn(n_dev, n_groups)
-                probe = _jit_cache_probe(fn, "sharded+triage")
+            elif sharded:
+                resilience.maybe_inject("sharded_dispatch")
+                fn = parallel_engine.sharded_grouped_fn(
+                    n_dev, n_groups, fused=fused
+                )
+                label = ("sharded+triage" if fused
+                         else "sharded-classic+triage")
+                probe = _jit_cache_probe(fn, label)
                 ok = fn(
                     jnp.asarray(pk.px), jnp.asarray(pk.py),
                     jnp.asarray(pk.pinf), *tail,
                 )
-                self.last_path = "sharded+triage"
+                self.last_path = label
             else:
-                fn = (_verify_fused_grouped_jit if choice == "1"
+                fn = (_verify_fused_grouped_jit if fused
                       else _verify_grouped_jit)
-                label = "fused+triage" if choice == "1" else "classic+triage"
+                label = "fused+triage" if fused else "classic+triage"
                 probe = _jit_cache_probe(fn, label)
                 ok = fn(
                     (jnp.asarray(pk.px), jnp.asarray(pk.py)),
@@ -1720,7 +1701,30 @@ class JaxBackend:
             probe()
             return ok
 
-        ok = _retry_stage("dispatch", stages, run)
+        if use_sharded:
+            try:
+                ok = _retry_stage("dispatch", stages, lambda: run(True))
+                parallel_engine.record_success()
+            except Exception as exc:
+                if not resilience.enabled():
+                    raise
+                category, kind = parallel_engine.record_failure(exc)
+                resilience.DEGRADED_TOTAL.inc(path="sharded")
+                _LOG.warn(
+                    "sharded triage dispatch failed; degrading to "
+                    "single-chip", devices=n_dev, category=category,
+                    kind=kind,
+                )
+                plan = parallel_engine.ShardPlan(
+                    1, pk.S, pk.S - pk.n, "degraded:" + kind
+                )
+                ok = _retry_stage("dispatch", stages, lambda: run(False))
+                self.last_path += "+sharded-fallback"
+        else:
+            ok = _retry_stage("dispatch", stages, lambda: run(False))
+        parallel_engine.record_dispatch(
+            plan, path=self.last_path, n_sets=pk.n
+        )
         TRIAGE_DISPATCHES.inc()
         if _LAST_TRIAGE.get("enabled"):
             _LAST_TRIAGE["dispatches"] = _LAST_TRIAGE.get("dispatches", 0) + 1
@@ -1971,17 +1975,16 @@ class JaxBackend:
             path_override, _fused_choice()
         )
         self._last_rung = "fused" if choice == "1" else "classic"
-        n_dev = len(jax.devices())
-        shard = os.environ.get("LHTPU_SHARDED_VERIFY")
-        use_sharded = choice == "1" and (
-            shard == "1"
-            or (shard is None and n_dev > 1 and jax.default_backend() == "tpu")
-        )
-        if use_sharded and S % n_dev:
-            # Pad the set axis so every chip gets a power-of-two local
-            # slice (pt_tree_sum in the scan fallback requires it);
-            # infinity lanes are inert. Never silently drop to one chip.
-            S = n_dev * _next_pow2(-(-S // n_dev))
+        # Device-count routing (parallel/engine.py): the plan may re-pad
+        # the set axis so every chip gets a power-of-two local slice
+        # (pt_tree_sum in the scan fallback requires it); infinity lanes
+        # are inert. Forced sharding is never silently dropped to one
+        # chip — only the engine's breaker (an earlier sharded permanent
+        # fault) or a rung override can.
+        plan = parallel_engine.plan(n, S, path_override=path_override)
+        n_dev = plan.devices
+        use_sharded = n_dev > 1
+        S = plan.S
 
         from .crypto.bls.curve import g1_infinity, g2_infinity
 
@@ -2075,11 +2078,17 @@ class JaxBackend:
 
         # Transfer + async enqueue (a jit-cache miss makes this stage the
         # trace+compile — bls_jit_cache_events_total disambiguates).
-        def run_device_dispatch():
+        # ``sharded``/``sched`` are parameters (not closed over) so the
+        # sharded-fault fallback below can re-run single-chip on the
+        # SAME packed grids: the sharded padding is still a power of
+        # two, so verdicts are bit-identical either way.
+        fused = choice == "1"
+
+        def run_device_dispatch(sharded: bool, sched):
             msm_args = (
                 ()
-                if msm_sched is None
-                else (jnp.asarray(msm_sched[0]), jnp.asarray(msm_sched[1]))
+                if sched is None
+                else (jnp.asarray(sched[0]), jnp.asarray(sched[1]))
             )
             tail = (
                 (jnp.asarray(sx), jnp.asarray(sy)),
@@ -2088,52 +2097,95 @@ class JaxBackend:
                 jnp.asarray(minf),
                 jnp.asarray(r_bits),
             )
-            if use_sharded and table_args is not None:
-                # All three fast paths composed: HBM-table gather +
-                # shard_map over a ("dp",) mesh + fused kernels.
+            if sharded and table_args is not None:
+                # Fast paths composed: HBM-table gather + shard_map
+                # over a ("dp",) mesh (+ fused kernels on TPU).
+                resilience.maybe_inject("sharded_dispatch")
                 tx, ty, idx, tinf = table_args
-                fn = _sharded_fused_fn(n_dev, indexed=True,
-                                       with_msm=bool(msm_args))
-                probe = _jit_cache_probe(fn, "sharded-indexed")
+                fn = parallel_engine.sharded_verify_fn(
+                    n_dev, fused=fused, indexed=True,
+                    with_msm=bool(msm_args),
+                )
+                label = ("sharded-indexed" if fused
+                         else "sharded-classic-indexed")
+                probe = _jit_cache_probe(fn, label)
                 ok = fn(
                     tx, ty, jnp.asarray(idx), jnp.asarray(tinf),
                     tail[0][0], tail[0][1], tail[1],
                     tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
                 )[0]
-                self.last_path = "sharded-indexed"
-            elif use_sharded:
-                # One code path to N chips: the fused core inside
+                self.last_path = label
+            elif sharded:
+                # One code path to N chips: the verify core inside
                 # shard_map over a ("dp",) mesh (parallel/sharding.py).
-                fn = _sharded_fused_fn(n_dev, with_msm=bool(msm_args))
-                probe = _jit_cache_probe(fn, "sharded")
+                resilience.maybe_inject("sharded_dispatch")
+                fn = parallel_engine.sharded_verify_fn(
+                    n_dev, fused=fused, with_msm=bool(msm_args)
+                )
+                label = "sharded" if fused else "sharded-classic"
+                probe = _jit_cache_probe(fn, label)
                 ok = fn(
                     jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
                     tail[0][0], tail[0][1], tail[1],
                     tail[2][0], tail[2][1], tail[3], tail[4], *msm_args,
                 )[0]
-                self.last_path = "sharded"
+                self.last_path = label
             elif table_args is not None:
                 tx, ty, idx, tinf = table_args
-                fn = (_verify_fused_indexed_jit if choice == "1"
+                fn = (_verify_fused_indexed_jit if fused
                       else _verify_indexed_jit)
                 probe = _jit_cache_probe(fn, "indexed")
                 ok = fn(tx, ty, jnp.asarray(idx), jnp.asarray(tinf), *tail,
                         *msm_args)
                 self.last_path = "indexed"
             else:
-                fn = _verify_fused_jit if choice == "1" else _verify_jit
+                fn = _verify_fused_jit if fused else _verify_jit
                 probe = _jit_cache_probe(
-                    fn, "fused" if choice == "1" else "classic"
+                    fn, "fused" if fused else "classic"
                 )
                 ok = fn((jnp.asarray(px), jnp.asarray(py)),
                         jnp.asarray(pinf), *tail, *msm_args)
-                self.last_path = "fused" if choice == "1" else "classic"
+                self.last_path = "fused" if fused else "classic"
             probe()
             return ok
 
-        ok = _retry_stage("dispatch", stages, run_device_dispatch)
+        if use_sharded:
+            try:
+                ok = _retry_stage(
+                    "dispatch", stages,
+                    lambda: run_device_dispatch(True, msm_sched),
+                )
+                parallel_engine.record_success()
+            except Exception as exc:
+                if not resilience.enabled():
+                    raise
+                # Chip loss / permanent sharded fault (or exhausted
+                # transient budget): trip the sharded breaker and
+                # answer from ONE chip with the same grids. The MSM
+                # schedule is per-chip-shaped, so the fallback reverts
+                # to the in-core scalar-mul scan (same verdict).
+                category, kind = parallel_engine.record_failure(exc)
+                resilience.DEGRADED_TOTAL.inc(path="sharded")
+                _LOG.warn(
+                    "sharded dispatch failed; degrading to single-chip",
+                    devices=n_dev, category=category, kind=kind,
+                )
+                plan = parallel_engine.ShardPlan(
+                    1, S, S - n, "degraded:" + kind
+                )
+                ok = _retry_stage(
+                    "dispatch", stages,
+                    lambda: run_device_dispatch(False, None),
+                )
+                self.last_path += "+sharded-fallback"
+        else:
+            ok = _retry_stage(
+                "dispatch", stages,
+                lambda: run_device_dispatch(False, msm_sched),
+            )
         if table_args is None and agg is not None:
             self.last_path += "+host-agg"
+        parallel_engine.record_dispatch(plan, path=self.last_path, n_sets=n)
         _LAST_PATH = self.last_path
         DISPATCH_BATCHES.inc(path=self.last_path)
         return ok

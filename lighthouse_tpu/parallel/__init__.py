@@ -1,23 +1,31 @@
-"""Multi-chip parallelism: mesh construction + sharded batch verification."""
+"""Multi-chip parallelism: mesh construction, sharded batch-verify
+programs, and the dispatch engine that routes production batches onto
+them (:mod:`lighthouse_tpu.parallel.engine`)."""
 
+from . import engine
 from .sharding import (
     build_sharded_fused_grouped_indexed_verifier,
     build_sharded_fused_grouped_verifier,
     build_sharded_fused_indexed_verifier,
     build_sharded_fused_smoke,
     build_sharded_fused_verifier,
+    build_sharded_grouped_indexed_verifier,
     build_sharded_grouped_verifier,
+    build_sharded_indexed_verifier,
     build_sharded_verifier,
     make_mesh,
 )
 
 __all__ = [
+    "engine",
     "build_sharded_fused_grouped_indexed_verifier",
     "build_sharded_fused_grouped_verifier",
     "build_sharded_fused_indexed_verifier",
     "build_sharded_fused_smoke",
     "build_sharded_fused_verifier",
+    "build_sharded_grouped_indexed_verifier",
     "build_sharded_grouped_verifier",
+    "build_sharded_indexed_verifier",
     "build_sharded_verifier",
     "make_mesh",
 ]

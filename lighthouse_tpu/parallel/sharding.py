@@ -175,6 +175,26 @@ def build_sharded_verifier(mesh: Mesh):
     return body
 
 
+def build_sharded_indexed_verifier(mesh: Mesh):
+    """Classic-XLA sharded verifier fed from the HBM pubkey table.
+
+    The CPU-viable twin of :func:`build_sharded_fused_indexed_verifier`:
+    the table gather runs at the XLA level *outside* the shard (the
+    gathered [S, K] limb grids are resharded over "dp" by the inner
+    program's in_specs) — on a forced-host CPU mesh that reshard is a
+    memcpy; TPU hardware uses the fused twin whose gather stays inside
+    the shard.
+    """
+    inner = build_sharded_verifier(mesh)
+
+    def fn(tx, ty, idx, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        px = tx[idx].astype(jnp.int32)
+        py = ty[idx].astype(jnp.int32)
+        return inner(px, py, pk_inf, sx, sy, sinf, mx, my, minf, r_bits)
+
+    return fn
+
+
 def build_sharded_fused_verifier(mesh: Mesh, with_msm: bool = False):
     """Sharded PRODUCTION verifier: the fused Pallas pipeline
     (jax_backend._verify_core_fused) with its set axis laid over "dp".
@@ -331,6 +351,20 @@ def build_sharded_grouped_verifier(mesh: Mesh, n_groups: int):
         return jax.lax.all_gather(ok, "dp").reshape(-1)
 
     return body
+
+
+def build_sharded_grouped_indexed_verifier(mesh: Mesh, n_groups: int):
+    """Classic-XLA grouped twin of :func:`build_sharded_indexed_verifier`
+    (triage's CPU-mesh route): XLA-level table gather outside the shard,
+    grouped verdict vector from the sharded classic program."""
+    inner = build_sharded_grouped_verifier(mesh, n_groups)
+
+    def fn(tx, ty, idx, pk_inf, sx, sy, sinf, mx, my, minf, r_bits):
+        px = tx[idx].astype(jnp.int32)
+        py = ty[idx].astype(jnp.int32)
+        return inner(px, py, pk_inf, sx, sy, sinf, mx, my, minf, r_bits)
+
+    return fn
 
 
 def build_sharded_fused_grouped_verifier(mesh: Mesh, n_groups: int):

@@ -153,6 +153,45 @@ def _reset_resilience_state():
     pmod = _sys.modules.get("lighthouse_tpu.common.pipeline")
     if pmod is not None and hasattr(pmod, "note_progress"):
         pmod._LAST_PROGRESS_T = 0.0
+    # And the dispatch engine's last-parallel snapshot (its breaker
+    # state lives in resilience and is already cleared above).
+    emod = _sys.modules.get("lighthouse_tpu.parallel.engine")
+    if emod is not None:
+        emod.reset()
+
+
+@pytest.fixture
+def eight_host_devices():
+    """Guarantee the 8-way forced-host mesh for sharded-dispatch tests.
+
+    The device count itself is fixed process-wide by the XLA_FLAGS set
+    at the top of this file (XLA reads it once, at backend init — a
+    per-test fixture cannot change it, which is also why nothing here
+    mutates XLA_FLAGS: it must not leak into other modules or
+    subprocesses the test spawns). The fixture's job is (a) skip when
+    the process came up with fewer devices (an externally pinned
+    XLA_FLAGS), and (b) restore every sharding/pipeline env knob the
+    test monkeys with, so a failing test cannot leak LHTPU_* state.
+    """
+    import jax as _jax
+
+    if len(_jax.devices()) < 8:
+        pytest.skip("needs 8 forced host devices (XLA_FLAGS pinned?)")
+    knobs = (
+        "LHTPU_SHARDED_VERIFY", "LHTPU_DEVICES", "LHTPU_SHARD_MIN_SETS",
+        "LHTPU_FUSED_VERIFY", "LHTPU_FAULT_INJECT", "LHTPU_PIPELINE",
+        "LHTPU_PIPELINE_MIN_SETS", "LHTPU_PIPELINE_CHUNK",
+        "LHTPU_VERDICT_GROUPS",
+    )
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        yield 8
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 @pytest.fixture

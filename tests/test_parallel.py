@@ -407,3 +407,51 @@ def test_sharded_grouped_verifier_matches_oracle():
     bad[3] = sx
     ok = np.asarray(fn(*bad))
     assert ok.tolist() == [True, False]
+
+
+# ------------------------------------------------ dispatch engine (ISSUE 8)
+# Pure-host checks of the engine layer this module's sharded programs now
+# serve through: builder wiring and the jitted-program cache. The routing/
+# parity/fault contracts live in tests/test_parallel_dispatch.py.
+
+
+def test_classic_sharded_indexed_builders_construct():
+    """The classic (pure-XLA) indexed sharded builders exist and wrap
+    without tracing — they are what serves indexed dispatch on CPU
+    meshes, sharing the fused variants' flat argument convention."""
+    from lighthouse_tpu.parallel import (
+        build_sharded_grouped_indexed_verifier,
+        build_sharded_indexed_verifier,
+    )
+
+    mesh = make_mesh(2, mp=1)
+    assert callable(build_sharded_indexed_verifier(mesh))
+    assert callable(build_sharded_grouped_indexed_verifier(mesh, 2))
+
+
+def test_engine_program_cache_is_keyed_and_stable():
+    """sharded_verify_fn/sharded_grouped_fn return the SAME jitted
+    program for the same key (compiles are the expensive part — the
+    cache must not rebuild per dispatch) and distinct programs for
+    distinct keys."""
+    from lighthouse_tpu.parallel import engine
+
+    a = engine.sharded_verify_fn(2, fused=False)
+    assert a is engine.sharded_verify_fn(2, fused=False)
+    assert a is not engine.sharded_verify_fn(2, fused=False, indexed=True)
+    g = engine.sharded_grouped_fn(2, 2, fused=False)
+    assert g is engine.sharded_grouped_fn(2, 2, fused=False)
+    assert g is not engine.sharded_grouped_fn(2, 4, fused=False)
+    with pytest.raises(AssertionError):
+        engine.sharded_verify_fn(2, fused=False, with_msm=True)
+
+
+def test_engine_topology_sees_forced_host_mesh():
+    """The conftest-forced 8-device host platform IS the discovered
+    topology (power-of-two floor of the visible count)."""
+    from lighthouse_tpu.parallel import engine
+
+    top = engine.topology()
+    assert top.visible == len(jax.devices())
+    assert top.n_devices & (top.n_devices - 1) == 0
+    assert top.n_devices <= top.visible

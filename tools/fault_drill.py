@@ -378,6 +378,123 @@ def run_drill_slot_load(kinds=KINDS, backend=None):
     return results
 
 
+#: multichip cells: faults fired INSIDE the sharded dispatch stage
+#: (transient → retried in place on the mesh; permanent, including a
+#: simulated chip loss, → circuit-break down to single-chip).
+MULTICHIP_KINDS = (
+    ("remote_compile", "transient"),
+    ("mosaic", "permanent"),
+    ("chip_loss", "permanent"),
+)
+
+
+def run_drill_multichip(kinds=MULTICHIP_KINDS, backend=None):
+    """Sharded-dispatch drill (ISSUE 8): faults injected into the
+    multi-chip composition while a batch spans the mesh.
+
+    Contract per cell:
+
+    * transient — the sharded dispatch is retried in place: verdict
+      True, >=1 retry, the path STAYS sharded, no degradation;
+    * permanent (``mosaic`` lowering bug, ``chip_loss`` device loss) —
+      the sharded breaker opens and the SAME packed grids re-dispatch
+      on one chip: verdict True (bit-identical), >=1 degraded dispatch,
+      ``path`` records the ``+sharded-fallback`` rung.
+
+    Returns [] when the process has fewer than 2 devices (the mesh
+    can't form; main() forces an 8-way host mesh before jax init so the
+    standalone drill always exercises these rows).
+    """
+    from lighthouse_tpu.common import resilience
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+    from lighthouse_tpu.jax_backend import JaxBackend
+    from lighthouse_tpu.parallel import engine
+
+    if engine.topology().n_devices < 2:
+        return []
+    if backend is None:
+        backend = JaxBackend()
+
+    # 8 single-pubkey sets: the (S=8, K=1) bucket the sharded test tier
+    # already compiles, one real set per chip on an 8-way mesh.
+    sks = [SecretKey.from_int(i + 7) for i in range(8)]
+    msgs = [bytes([i + 1]) * 32 for i in range(8)]
+    sets = [
+        SignatureSet.single_pubkey(sks[i].sign(msgs[i]),
+                                   sks[i].public_key(), msgs[i])
+        for i in range(8)
+    ]
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_SHARDED_VERIFY",
+                  "LHTPU_DEVICES")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_SHARDED_VERIFY"] = "1"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    results = []
+    try:
+        resilience.reset()
+        engine.reset()
+        assert backend.verify_signature_sets(sets), \
+            "healthy sharded warm pass failed"
+        healthy_path = backend.last_path
+        assert "sharded" in healthy_path, (
+            f"sharded path did not engage: {healthy_path}"
+        )
+
+        for kind, category in kinds:
+            resilience.reset()
+            engine.reset()
+            retries0 = _total(resilience.RETRIES_TOTAL)
+            degraded0 = _total(resilience.DEGRADED_TOTAL)
+            os.environ["LHTPU_FAULT_INJECT"] = f"sharded_dispatch:{kind}:1"
+            error = None
+            try:
+                verdict = backend.verify_signature_sets(sets)
+            except Exception as exc:  # contract breach, not a crash
+                verdict = None
+                error = f"{type(exc).__name__}: {exc}"
+            finally:
+                os.environ.pop("LHTPU_FAULT_INJECT", None)
+            retries = _total(resilience.RETRIES_TOTAL) - retries0
+            degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+            path = backend.last_path
+            if category == "transient":
+                ok = (verdict is True and retries >= 1 and degraded == 0
+                      and "sharded" in path
+                      and "+sharded-fallback" not in path)
+            else:
+                ok = (verdict is True and degraded >= 1
+                      and path.endswith("+sharded-fallback"))
+            results.append({
+                "mode": "multichip",
+                "stage": "sharded_dispatch",
+                "kind": kind,
+                "category": category,
+                "verdict": verdict,
+                "retries": retries,
+                "degraded": degraded,
+                "path": path,
+                "healthy_path": healthy_path,
+                "reason": engine.parallel_report().get("reason"),
+                "error": error,
+                "ok": ok,
+            })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+        engine.reset()
+    return results
+
+
 def run_drill_soak():
     """Multi-epoch soak drill (ISSUE 7): two endurance cells over
     ``loadgen/soak.SoakRunner`` on the virtual clock, aggregate-only
@@ -503,11 +620,28 @@ def main() -> int:
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
     out = sys.stderr if json_mode else sys.stdout
 
+    # Force an 8-way host mesh BEFORE jax initializes so the multichip
+    # rows always run (the flag only affects the host CPU platform —
+    # real TPU meshes are untouched).
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
     import jax
 
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
+    n_multichip = len(MULTICHIP_KINDS) if len(jax.devices()) > 1 else 0
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -519,6 +653,9 @@ def main() -> int:
     # Serving-loop matrix (ISSUE 6): transients injected mid-slot into
     # a loadgen poison-storm replay — degrade, never crash.
     results += run_drill_slot_load()
+    # Multichip matrix (ISSUE 8): faults inside the sharded dispatch —
+    # transients retried on the mesh, chip loss degrades to one chip.
+    results += run_drill_multichip()
     # Soak matrix (ISSUE 7): multi-epoch chaos → re-promotion + digest
     # parity; sustained permanents degrade, never crash.
     results += run_drill_soak()

@@ -10,7 +10,12 @@ ONE parseable JSON line — {"metric": "bls_stage_profile", "stages_ms":
 {...}} — the same per-stage breakdown shape bench.py embeds, so a
 round's BENCH json can carry a device-stage profile.
 
-Usage:  python tools/profile_stages.py [S] [--json]
+``--devices N`` switches to the multi-chip profile (ISSUE 8): one warm
+sharded verify on an N-way mesh (forced host devices off-TPU), per-shard
+stage attribution, and the cross-chip fold round measured in isolation
+with its share of the device dispatch stage.
+
+Usage:  python tools/profile_stages.py [S] [--json] [--devices N]
 """
 
 from __future__ import annotations
@@ -33,8 +38,33 @@ def record(label: str, ms: float) -> None:
     print(f"{label:42s} {ms:10.1f} ms",
           file=sys.stderr if JSON_MODE else sys.stdout)
 
+def _devices_arg() -> int | None:
+    """``--devices N`` — profile the SHARDED dispatch at an N-way mesh
+    instead of the single-chip kernel stages; None when absent."""
+    if "--devices" not in sys.argv:
+        return None
+    i = sys.argv.index("--devices")
+    if i + 1 < len(sys.argv):
+        try:
+            return max(1, int(sys.argv[i + 1]))
+        except ValueError:
+            pass
+    return 8
+
+
+DEVICES = _devices_arg()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "")
+
+# The host mesh must be forced BEFORE jax initializes (XLA reads the
+# flag once, at backend init); only affects the CPU platform.
+if DEVICES and DEVICES > 1:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={DEVICES}"
+        ).strip()
 
 import jax
 import jax.numpy as jnp
@@ -223,6 +253,99 @@ def main():
         }), flush=True)
 
 
+def profile_multichip(n_dev: int) -> None:
+    """``--devices N`` (ISSUE 8): stage attribution of a SHARDED verify.
+
+    Runs a warm end-to-end verify with the dispatch engine forced onto
+    an N-way mesh and reports the host stages (pack / hash / scalars)
+    that stay serial, the device dispatch stage that now runs with
+    S/N sets per shard, and — separately measured via the engine's fold
+    probe — the cross-chip fold round that is the sharding overhead,
+    as a share of the device stage."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+    from lighthouse_tpu.parallel import engine
+
+    out = sys.stderr if JSON_MODE else sys.stdout
+    tpu = jax.devices()[0].platform == "tpu"
+    if not tpu:
+        # reuse the test tier's cache — the sharded classic programs at
+        # the (S=8, K=1) profile shape are exactly what it compiles
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    S = int(os.environ.get("PROFILE_SETS", "2048" if tpu else "8"))
+    print(f"device={jax.devices()[0].platform} devices={n_dev} S={S} "
+          f"(multichip profile)", file=out)
+
+    sks = [SecretKey.from_int(i + 7) for i in range(S)]
+    msgs = [bytes([(i % 255) + 1]) * 32 for i in range(S)]
+    sets = [
+        SignatureSet.single_pubkey(sks[i].sign(msgs[i]),
+                                   sks[i].public_key(), msgs[i])
+        for i in range(S)
+    ]
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_DEVICES", "LHTPU_SHARDED_VERIFY", "LHTPU_PIPELINE")
+    }
+    os.environ["LHTPU_DEVICES"] = str(n_dev)
+    os.environ["LHTPU_SHARDED_VERIFY"] = "1" if n_dev > 1 else "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    try:
+        be = jb.JaxBackend()
+        assert be.verify_signature_sets(sets)   # compile / cache load
+        t0 = time.perf_counter()
+        assert be.verify_signature_sets(sets)   # steady state
+        wall = time.perf_counter() - t0
+
+        rep = jb.dispatch_stage_report()
+        par = rep.get("parallel") or {}
+        stages_ms = rep.get("stages_ms") or {}
+        per_shard = par.get("sets_per_chip")
+        for stage, ms in sorted(stages_ms.items()):
+            suffix = (f"  ({per_shard} sets/shard x {n_dev})"
+                      if stage == "dispatch" and n_dev > 1 else "")
+            record(f"{stage}{suffix}", ms)
+        record("e2e (warm)", wall * 1e3)
+
+        fold_ms = engine.measure_fold_ms(n_dev) if n_dev > 1 else 0.0
+        dispatch_ms = stages_ms.get("dispatch") or 0.0
+        fold_share = (round(fold_ms / dispatch_ms, 4)
+                      if dispatch_ms > 0 else 0.0)
+        record("cross_chip_fold (probe)", fold_ms)
+        print(f"multichip: path={rep.get('path')} "
+              f"mesh={par.get('mesh')} pad_waste={par.get('pad_waste')} "
+              f"fold_share_of_dispatch={fold_share}", file=out)
+
+        if JSON_MODE:
+            print(json.dumps({
+                "metric": "bls_stage_profile_multichip",
+                "stages_ms": STAGES_MS,
+                "detail": {
+                    "S": S,
+                    "device": jax.devices()[0].platform,
+                    "devices": n_dev,
+                    "sets_per_shard": per_shard,
+                    "fold_ms": fold_ms,
+                    "fold_share": fold_share,
+                    "path": rep.get("path"),
+                    "parallel": par,
+                },
+            }), flush=True)
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 def profile_pipeline_overlap(sets) -> dict:
     """Run one pipelined verify and report host-hidden vs host-exposed
     seconds per dispatch stage (None-shaped dict when the batch doesn't
@@ -255,4 +378,7 @@ def profile_pipeline_overlap(sets) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    if DEVICES is not None:
+        profile_multichip(DEVICES)
+    else:
+        main()
