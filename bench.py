@@ -34,6 +34,8 @@ import time
 
 import numpy as np
 
+from lighthouse_tpu.common import knobs
+
 def _probe_backend(attempts: int = 3, timeout: int = 300) -> str | None:
     """Initialize the configured backend in a THROWAWAY subprocess.
 
@@ -77,17 +79,30 @@ _HEADLINE_EMITTED = False
 _INTENDED_RC = 0
 
 
+def _note_swallowed(where: str, exc: BaseException) -> None:
+    """Classifier-routed record for every exception bench absorbs: the
+    resilience (category, kind) plus the repr land on stderr, so an
+    absorbed failure is attributable instead of silent (LH5xx)."""
+    from lighthouse_tpu.common import resilience
+
+    category, kind = resilience.classify(exc)
+    sys.stderr.write(
+        f"bench: {where} swallowed {category}/{kind}: {exc!r}\n"
+    )
+
+
 def _stage_report() -> dict | None:
     """Per-stage attribution of the most recent BLS dispatch (stage wall
     times, error counts, the stage the last failure raised in). Reads
     the already-imported backend module only — a fallback line must not
     trigger fresh imports mid-crash."""
+    jb = sys.modules.get("lighthouse_tpu.jax_backend")
+    if jb is None:
+        return None
     try:
-        jb = sys.modules.get("lighthouse_tpu.jax_backend")
-        if jb is None:
-            return None
         return jb.dispatch_stage_report()
-    except Exception:
+    except Exception as exc:
+        _note_swallowed("stage_report", exc)
         return None
 
 
@@ -125,6 +140,31 @@ def _pipeline_detail() -> dict:
             },
         }
     }
+
+
+_LINT_CACHE: dict | None = None
+
+
+def _lint_detail() -> dict:
+    """{"lint": {version, clean, findings}} for EVERY emitted JSON
+    line — provenance: which lint suite blessed the tree this number
+    came from, and whether it was actually clean (ISSUE 9). Linted
+    once per process (pure-AST, sub-second) and cached."""
+    global _LINT_CACHE
+    if _LINT_CACHE is None:
+        try:
+            from tools.lint import LINT_VERSION, run_lint
+
+            findings = run_lint(os.path.dirname(os.path.abspath(__file__)))
+            _LINT_CACHE = {
+                "version": LINT_VERSION,
+                "clean": not findings,
+                "findings": len(findings),
+            }
+        except Exception as exc:
+            _note_swallowed("lint_detail", exc)
+            _LINT_CACHE = {"version": None, "clean": None, "findings": None}
+    return {"lint": _LINT_CACHE}
 
 
 def _triage_detail() -> dict:
@@ -177,6 +217,7 @@ def _emit_config_fallback(metric: str, config: int, err: Exception) -> None:
             "stages": _stage_report(),
             **_resilience_detail(),
             **_parallel_detail(),
+            **_lint_detail(),
         },
     }), flush=True)
 
@@ -215,6 +256,7 @@ def _emit_fallback(err: str) -> None:
     line.update(_pipeline_detail())
     line.update(_triage_detail())
     line.update(_parallel_detail())
+    line.update(_lint_detail())
     stages = _stage_report()
     if stages is not None:
         line["stages"] = stages
@@ -282,6 +324,7 @@ def slot_chain_mode() -> None:
             **_pipeline_detail(),
             **_triage_detail(),
             **_parallel_detail(),
+            **_lint_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -418,6 +461,7 @@ def slot_load_mode() -> None:
             **_pipeline_detail(),
             **_triage_detail(),
             **_parallel_detail(),
+            **_lint_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -563,6 +607,7 @@ def slot_mode() -> None:
             **_pipeline_detail(),
             **_triage_detail(),
             **_parallel_detail(),
+            **_lint_detail(),
         },
     }), flush=True)
     global _HEADLINE_EMITTED
@@ -654,12 +699,10 @@ def devices_mode(platform: str) -> None:
     )
 
     backend = JaxBackend()
-    saved = {
-        k: os.environ.get(k)
-        for k in ("LHTPU_DEVICES", "LHTPU_SHARDED_VERIFY")
-    }
     base_rate = None
-    try:
+    with knobs.scoped_env(
+        {"LHTPU_DEVICES": None, "LHTPU_SHARDED_VERIFY": None}
+    ):
         for n in ns:
             os.environ["LHTPU_DEVICES"] = str(n)
             os.environ["LHTPU_SHARDED_VERIFY"] = "1" if n > 1 else "0"
@@ -694,6 +737,7 @@ def devices_mode(platform: str) -> None:
                             "validated": False, "parallel": par,
                             "stages": _stage_report(),
                             **_resilience_detail(),
+                            **_lint_detail(),
                         },
                     }), flush=True)
                     continue
@@ -727,17 +771,12 @@ def devices_mode(platform: str) -> None:
                         "device": platform,
                         "stages": _stage_report(),
                         **_resilience_detail(),
+                        **_lint_detail(),
                         **_pipeline_detail(),
                     },
                 }), flush=True)
             except Exception as e:
                 _emit_config_fallback("multichip_sets_per_sec", n, e)
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
     _HEADLINE_EMITTED = True
 
 
@@ -759,10 +798,10 @@ def pipeline_sweep(backend, sets, reps: int, which: str) -> None:
     carrying ``detail.pipeline`` — chunk count, overlap seconds, cache
     hit rates — so the on/off perf delta is attributable."""
     modes = ("off", "on") if which == "sweep" else (which,)
-    prev = os.environ.get("LHTPU_PIPELINE")
-    try:
-        for mode in modes:
-            os.environ["LHTPU_PIPELINE"] = "1" if mode == "on" else "0"
+    for mode in modes:
+        with knobs.scoped_env(
+            {"LHTPU_PIPELINE": "1" if mode == "on" else "0"}
+        ):
             from lighthouse_tpu.common import pipeline as _pl
 
             _pl.reset()  # else the off line reports the prior on-run
@@ -782,13 +821,9 @@ def pipeline_sweep(backend, sets, reps: int, which: str) -> None:
                     "path": backend.last_path,
                     **_pipeline_detail(),
                     **_parallel_detail(),
+                    **_lint_detail(),
                 },
             }), flush=True)
-    finally:
-        if prev is None:
-            os.environ.pop("LHTPU_PIPELINE", None)
-        else:
-            os.environ["LHTPU_PIPELINE"] = prev
 
 
 def _message_dup_cli_arg() -> list[int] | None:
@@ -852,6 +887,7 @@ def message_dup_sweep(backend, S: int, reps: int,
                     **_pipeline_detail(),
                     **_resilience_detail(),
                     **_parallel_detail(),
+                    **_lint_detail(),
                 },
             }), flush=True)
         except Exception as e:
@@ -953,6 +989,7 @@ def configs_mode(backend, nb) -> None:
                 "device_ms": round(dt1 * 1e3, 1),
                 "native_cpu_ms": round(nat1 * 1e3, 1) if nat1 else None,
                 **_resilience_detail(),
+                **_lint_detail(),
             },
         }))
 
@@ -1002,6 +1039,7 @@ def configs_mode(backend, nb) -> None:
                 "device": dev, "device_ms": round(dt2 * 1e3, 1),
                 "native_cpu_ms": round(nat2 * 1e3, 1) if nat2 else None,
                 **_resilience_detail(),
+                **_lint_detail(),
             },
         }))
 
@@ -1024,14 +1062,11 @@ def configs_mode(backend, nb) -> None:
         path3 = backend.last_path
         # raw device path for the record (production routes tiny batches to
         # the native host fallback — jax_backend._dispatch cost model)
-        os.environ["LHTPU_HOST_FALLBACK"] = "0"
-        try:
+        with knobs.scoped_env({"LHTPU_HOST_FALLBACK": "0"}):
             assert _forced_sets(backend, [set3])  # compile + warm
             t0 = time.perf_counter()
             assert _forced_sets(backend, [set3])
             dev3 = time.perf_counter() - t0
-        finally:
-            del os.environ["LHTPU_HOST_FALLBACK"]
         nat3 = None
         if nb is not None:
             assert _forced_sets(nb, [set3])
@@ -1050,6 +1085,7 @@ def configs_mode(backend, nb) -> None:
                 "device_forced_ms": round(dev3 * 1e3, 1),
                 "native_cpu_ms": round(nat3 * 1e3, 1) if nat3 else None,
                 "retries": _resilience_detail()["retries"],
+                **_lint_detail(),
             },
         }))
 
@@ -1132,7 +1168,7 @@ def main() -> None:
         jnp.asarray(r_bits),
     )
     # Bucketed-MSM schedule: the fused production path (ops/msm.py).
-    if fused_choice == "1" and os.environ.get("LHTPU_MSM_VERIFY", "1") == "1":
+    if fused_choice == "1" and knobs.knob("LHTPU_MSM_VERIFY"):
         sched = _msm.build_schedule(r_u64, _msm.max_rounds(S))
         if sched is not None:
             dev_args = dev_args + (jnp.asarray(sched[0]), jnp.asarray(sched[1]))
@@ -1160,6 +1196,7 @@ def main() -> None:
                           "error": "exactness gate failed",
                           "stages": _stage_report(),
                           **_resilience_detail(),
+                          **_lint_detail(),
                           **_pipeline_detail()}), flush=True)
         _HEADLINE_EMITTED = True
         _INTENDED_RC = 1
@@ -1263,6 +1300,7 @@ def main() -> None:
     detail.update(_resilience_detail())
     detail.update(headline_pipeline)
     detail.update(_triage_detail())
+    detail.update(_lint_detail())
     detail.update(headline_parallel)
     detail["path"] = headline_path
 

@@ -22,11 +22,11 @@ explicit lane mask.
 
 from __future__ import annotations
 
-import os
 from collections import OrderedDict
 
 import numpy as np
 
+from .common import knobs
 from .common.metrics import REGISTRY
 from .utils import next_pow2
 
@@ -64,13 +64,18 @@ CACHE_ENTRIES = REGISTRY.gauge(
 
 
 def input_caches_enabled() -> bool:
-    return os.environ.get("LHTPU_INPUT_CACHE", "1") == "1"
+    return bool(knobs.knob("LHTPU_INPUT_CACHE"))
 
 
 class InputCache:
-    """Bounded LRU of small host values with hit/miss/evict metrics."""
+    """Bounded LRU of small host values with hit/miss/evict metrics.
 
-    def __init__(self, name: str, env_var: str, default_capacity: int):
+    ``default_capacity`` is only for UNREGISTERED env vars (tests inject
+    throwaway names); registered knobs take their default from the
+    registry so the number is declared exactly once."""
+
+    def __init__(self, name: str, env_var: str,
+                 default_capacity: int | None = None):
         self.name = name
         self._env_var = env_var
         self._default_cap = default_capacity
@@ -78,10 +83,7 @@ class InputCache:
 
     @property
     def capacity(self) -> int:
-        try:
-            return max(1, int(os.environ.get(self._env_var, "")))
-        except ValueError:
-            return self._default_cap
+        return max(1, knobs.maybe_int(self._env_var, self._default_cap))
 
     def __len__(self) -> int:
         return len(self._data)
@@ -118,7 +120,8 @@ class PubkeyRowCache:
     batch resolves to slot indices in one Python pass and gathers rows
     with two np.take calls — no bigint work at all."""
 
-    def __init__(self, name: str, env_var: str, default_capacity: int):
+    def __init__(self, name: str, env_var: str,
+                 default_capacity: int | None = None):
         self.name = name
         self._env_var = env_var
         self._default_cap = default_capacity
@@ -129,10 +132,7 @@ class PubkeyRowCache:
 
     @property
     def capacity(self) -> int:
-        try:
-            return max(2, int(os.environ.get(self._env_var, "")))
-        except ValueError:
-            return self._default_cap
+        return max(2, knobs.maybe_int(self._env_var, self._default_cap))
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -207,8 +207,8 @@ class PubkeyRowCache:
         CACHE_ENTRIES.set(0, cache=self.name)
 
 
-PUBKEY_ROW_CACHE = PubkeyRowCache("pubkey_rows", "LHTPU_PUBKEY_CACHE", 65536)
-HTC_CACHE = InputCache("hash_to_curve", "LHTPU_HTC_CACHE", 4096)
+PUBKEY_ROW_CACHE = PubkeyRowCache("pubkey_rows", "LHTPU_PUBKEY_CACHE")
+HTC_CACHE = InputCache("hash_to_curve", "LHTPU_HTC_CACHE")
 
 
 def pubkey_cache_key(pk):

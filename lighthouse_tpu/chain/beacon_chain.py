@@ -311,7 +311,7 @@ class BeaconChain:
             )
             if status == ExecutionStatus.VALID:
                 self.fork_choice.on_valid_execution_payload(self._head.root)
-        except Exception:
+        except Exception:  # lhtpu: ignore[LH502] -- execution engine offline is an expected steady state; chain stays optimistic
             pass  # engine offline: stay optimistic (engines.rs fallback)
 
     def _check_finalization(self) -> None:
@@ -340,7 +340,7 @@ class BeaconChain:
                     if int(state.slot) % p.SLOTS_PER_EPOCH == 0:
                         try:
                             self.store.migrate(state, finalized_root)
-                        except Exception:
+                        except Exception:  # lhtpu: ignore[LH502] -- freezer migration is best-effort background work; hot store remains authoritative
                             pass  # migration is best-effort background work
 
     # ====================================================== block production

@@ -648,7 +648,7 @@ def run_lcli(args) -> int:
                 ) == fork:
                     state = candidate
                     break
-            except Exception:
+            except Exception:  # lhtpu: ignore[LH502] -- probing candidate pre-state decodings; failures mean try the next fork
                 continue
         if state is None:
             print(json.dumps({"error": "undecodable pre-state"}),

@@ -41,12 +41,12 @@ clock and probes so unit tests drive them on a virtual clock.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 
 from . import monitoring
+from .knobs import knob
 from .metrics import REGISTRY
 
 HEALTHY, DEGRADED, CRITICAL = 0, 1, 2
@@ -72,20 +72,6 @@ def level_name(level: int) -> str:
     return _LEVEL_NAMES.get(level, str(level))
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
-
 class Sentinel:
     """One watched trajectory. ``check(now)`` returns (level, detail);
     implementations must be cheap — the governor runs every sentinel
@@ -107,11 +93,11 @@ class RssGrowthSentinel(Sentinel):
                  growth_mb: float | None = None,
                  critical_mb: float | None = None,
                  read_rss=monitoring.read_rss_bytes):
-        self.window_s = (_env_float("LHTPU_RSS_WINDOW_S", 60.0)
+        self.window_s = (knob("LHTPU_RSS_WINDOW_S")
                          if window_s is None else window_s)
-        self.growth_mb = (_env_float("LHTPU_RSS_GROWTH_MB", 512.0)
+        self.growth_mb = (knob("LHTPU_RSS_GROWTH_MB")
                           if growth_mb is None else growth_mb)
-        self.critical_mb = (_env_float("LHTPU_RSS_CRITICAL_MB", 16384.0)
+        self.critical_mb = (knob("LHTPU_RSS_CRITICAL_MB")
                             if critical_mb is None else critical_mb)
         self._read_rss = read_rss
         self._samples: deque[tuple[float, int]] = deque()
@@ -145,7 +131,7 @@ class JitCacheSentinel(Sentinel):
     def __init__(self, max_entries: int | None = None,
                  entries_fn=monitoring.jit_cache_entry_count,
                  clear_fn=None):
-        self.max_entries = (_env_int("LHTPU_JIT_CACHE_MAX", 512)
+        self.max_entries = (knob("LHTPU_JIT_CACHE_MAX")
                             if max_entries is None else max_entries)
         self._entries = entries_fn
         self._clear = clear_fn if clear_fn is not None else _clear_jit_caches
@@ -181,13 +167,13 @@ def _clear_jit_caches() -> None:
         import jax
 
         jax.clear_caches()
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- best-effort hygiene action; jax may be absent or torn down mid-shutdown
         pass
     try:
         from .. import blsrt
 
         blsrt.reset_input_caches()
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- best-effort hygiene action; arena reset must not fail the sentinel
         pass
     monitoring.note_jit_cache_cleared(cause="watermark")
 
@@ -201,9 +187,9 @@ class CacheHitRateSentinel(Sentinel):
 
     def __init__(self, floor: float | None = None,
                  min_samples: int | None = None, report_fn=None):
-        self.floor = (_env_float("LHTPU_CACHE_HIT_FLOOR", 0.05)
+        self.floor = (knob("LHTPU_CACHE_HIT_FLOOR")
                       if floor is None else floor)
-        self.min_samples = (_env_int("LHTPU_CACHE_MIN_SAMPLES", 4096)
+        self.min_samples = (knob("LHTPU_CACHE_MIN_SAMPLES")
                             if min_samples is None else min_samples)
         self._report = report_fn if report_fn is not None else _input_caches
         self._last: dict[str, tuple[float, float]] = {}
@@ -248,9 +234,9 @@ class BreakerFlapSentinel(Sentinel):
                  transitions_fn=None, states_fn=None):
         from . import resilience
 
-        self.window_s = (_env_float("LHTPU_FLAP_WINDOW_S", 60.0)
+        self.window_s = (knob("LHTPU_FLAP_WINDOW_S")
                          if window_s is None else window_s)
-        self.max_flaps = (_env_int("LHTPU_FLAP_MAX", 6)
+        self.max_flaps = (knob("LHTPU_FLAP_MAX")
                           if max_flaps is None else max_flaps)
         self._transitions = (transitions_fn if transitions_fn is not None
                              else resilience.breaker_transitions_total)
@@ -287,7 +273,7 @@ class SloBreachSentinel(Sentinel):
     name = "slo_breach"
 
     def __init__(self, streak: int | None = None):
-        self.streak = (_env_int("LHTPU_SLO_BREACH_STREAK", 3)
+        self.streak = (knob("LHTPU_SLO_BREACH_STREAK")
                        if streak is None else streak)
         self.current = 0
 

@@ -47,7 +47,7 @@ def read_rss_bytes() -> int:
         import resource
 
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- resource module absent off-unix; 0 means RSS unknown
         return 0
 
 

@@ -15,24 +15,21 @@ stage-time on every later chunk is *hidden* behind the device compute of
 the chunks already in flight. The hidden share is what the pipeline
 buys, exported as ``bls_pipeline_overlap_seconds``.
 
-Env knobs:
-
-* ``LHTPU_PIPELINE``           — ``0`` restores single-shot dispatch
-  (default ``1``).
-* ``LHTPU_PIPELINE_MIN_SETS``  — batches below this stay single-shot
-  (default 512; below that the stage histograms show host assembly is
-  too small to hide anything but compile-bucket churn).
-* ``LHTPU_PIPELINE_CHUNK``     — fixed power-of-two chunk size override;
-  default ``max(256, next_pow2(n) // 4)``, i.e. roughly four chunks in
-  flight so pack(i+1) has a full device verify to hide behind.
+Env knobs (declared in :mod:`lighthouse_tpu.common.knobs`):
+``LHTPU_PIPELINE`` (off switch), ``LHTPU_PIPELINE_MIN_SETS`` (batches
+below it stay single-shot — below the default 512 the stage histograms
+show host assembly is too small to hide anything but compile-bucket
+churn), ``LHTPU_PIPELINE_CHUNK`` (fixed power-of-two chunk override;
+unset picks ``max(256, next_pow2(n) // 4)``, i.e. roughly four chunks
+in flight so pack(i+1) has a full device verify to hide behind).
 """
 
 from __future__ import annotations
 
-import os
 import time
 
 from ..utils import next_pow2
+from . import knobs
 from .metrics import REGISTRY
 
 PIPELINE_CHUNKS = REGISTRY.counter(
@@ -44,19 +41,15 @@ PIPELINE_OVERLAP = REGISTRY.counter(
     "Host pack/hash/schedule seconds hidden behind device compute",
 )
 
-DEFAULT_MIN_SETS = 512
 MIN_CHUNK = 256
 
 
 def enabled() -> bool:
-    return os.environ.get("LHTPU_PIPELINE", "1") == "1"
+    return bool(knobs.knob("LHTPU_PIPELINE"))
 
 
 def min_sets() -> int:
-    try:
-        return max(2, int(os.environ.get("LHTPU_PIPELINE_MIN_SETS", "")))
-    except ValueError:
-        return DEFAULT_MIN_SETS
+    return max(2, int(knobs.knob("LHTPU_PIPELINE_MIN_SETS")))
 
 
 def chunk_size(n: int) -> int:
@@ -70,17 +63,15 @@ def chunk_size(n: int) -> int:
     ``LHTPU_PIPELINE_CHUNK`` always wins (tests pin exact chunk
     geometries with it).
     """
-    raw = os.environ.get("LHTPU_PIPELINE_CHUNK", "")
-    try:
-        return max(2, next_pow2(int(raw)))
-    except ValueError:
-        pass
+    forced = knobs.knob("LHTPU_PIPELINE_CHUNK")
+    if forced is not None:
+        return max(2, next_pow2(int(forced)))
     base = max(MIN_CHUNK, next_pow2(n) // 4)
     try:
         from ..parallel import engine
 
         floor = engine.chunk_floor()
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- engine pulls in jax; chunk sizing must still work where the mesh stack can't load
         floor = 1
     if floor > 1:
         base = max(base, next_pow2(floor))

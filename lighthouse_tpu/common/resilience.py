@@ -35,33 +35,25 @@ errors: a wedged device transfer becomes a classified transient
 bench watchdog budget (deadline-in-a-worker-thread, the same
 surface-don't-deadlock discipline as ``common/timeout_lock.py``).
 
-Env knobs (all read at call time, not import time — the PR 1
-trace-time convention — except breaker threshold/cooldown, read when a
-breaker is (re)created, i.e. at import or :func:`reset`):
-
-========================  =======================================
-``LHTPU_RESILIENCE``      ``0`` disables retry/ladder (raw raise)
-``LHTPU_RETRY_MAX``       max transient retries per stage (3)
-``LHTPU_RETRY_BASE_MS``   first backoff (50 ms; doubles per try)
-``LHTPU_RETRY_CAP_MS``    backoff ceiling (2000 ms)
-``LHTPU_RETRY_JITTER``    jitter fraction on top (0.25)
-``LHTPU_RETRY_SEED``      seed the jitter RNG (deterministic tests)
-``LHTPU_BREAKER_THRESHOLD``  consecutive failures to open (3)
-``LHTPU_BREAKER_COOLDOWN_S`` open → half-open probe delay (30)
-``LHTPU_SYNC_DEADLINE_S`` device_sync deadline (900; <=0 inline)
-``LHTPU_FAULT_INJECT``    ``stage:kind:count[,...]`` injection spec
-``LHTPU_FAULT_HANG_S``    sleep length of the ``hang`` kind (3600)
-========================  =======================================
+Env knobs (declared in :mod:`lighthouse_tpu.common.knobs`, all read at
+call time, not import time — the PR 1 trace-time convention — except
+breaker threshold/cooldown, read when a breaker is (re)created, i.e. at
+import or :func:`reset`): ``LHTPU_RESILIENCE``, ``LHTPU_RETRY_MAX``,
+``LHTPU_RETRY_BASE_MS``, ``LHTPU_RETRY_CAP_MS``, ``LHTPU_RETRY_JITTER``,
+``LHTPU_RETRY_SEED``, ``LHTPU_BREAKER_THRESHOLD``,
+``LHTPU_BREAKER_COOLDOWN_S``, ``LHTPU_SYNC_DEADLINE_S``,
+``LHTPU_FAULT_INJECT``, ``LHTPU_FAULT_HANG_S`` — see the registry (or
+README's knob table) for defaults and semantics.
 """
 
 from __future__ import annotations
 
-import os
 import random
 import sys
 import threading
 import time
 
+from . import knobs
 from .metrics import REGISTRY
 
 TRANSIENT = "transient"
@@ -111,7 +103,7 @@ BREAKER_TRANSITIONS = REGISTRY.counter(
 def enabled() -> bool:
     """Retry + degradation ladder on? (``LHTPU_RESILIENCE=0`` restores
     the raw raise-through behavior; read per call.)"""
-    return os.environ.get("LHTPU_RESILIENCE", "1") != "0"
+    return bool(knobs.knob("LHTPU_RESILIENCE"))
 
 
 class DeadlineExceeded(TimeoutError):
@@ -217,7 +209,7 @@ def _jitter_rng() -> random.Random:
     """The module jitter RNG, re-seeded whenever LHTPU_RETRY_SEED
     changes (deterministic backoff schedules for tests/drills)."""
     global _JITTER_SEED_SEEN
-    seed = os.environ.get("LHTPU_RETRY_SEED")
+    seed = knobs.knob("LHTPU_RETRY_SEED")
     if seed != _JITTER_SEED_SEEN:
         _JITTER_SEED_SEEN = seed
         _JITTER_RNG.seed(None if seed is None else seed)
@@ -231,21 +223,20 @@ class RetryPolicy:
     def __init__(self, max_retries: int | None = None,
                  base_s: float | None = None, cap_s: float | None = None,
                  jitter: float | None = None):
-        env = os.environ.get
         self.max_retries = (
-            int(env("LHTPU_RETRY_MAX", "3")) if max_retries is None
+            int(knobs.knob("LHTPU_RETRY_MAX")) if max_retries is None
             else max_retries
         )
         self.base_s = (
-            float(env("LHTPU_RETRY_BASE_MS", "50")) / 1e3 if base_s is None
+            knobs.knob("LHTPU_RETRY_BASE_MS") / 1e3 if base_s is None
             else base_s
         )
         self.cap_s = (
-            float(env("LHTPU_RETRY_CAP_MS", "2000")) / 1e3 if cap_s is None
+            knobs.knob("LHTPU_RETRY_CAP_MS") / 1e3 if cap_s is None
             else cap_s
         )
         self.jitter = (
-            float(env("LHTPU_RETRY_JITTER", "0.25")) if jitter is None
+            knobs.knob("LHTPU_RETRY_JITTER") if jitter is None
             else jitter
         )
 
@@ -308,14 +299,13 @@ class CircuitBreaker:
 
     def __init__(self, name: str, threshold: int | None = None,
                  cooldown_s: float | None = None, clock=time.monotonic):
-        env = os.environ.get
         self.name = name
         self.threshold = (
-            int(env("LHTPU_BREAKER_THRESHOLD", "3")) if threshold is None
+            int(knobs.knob("LHTPU_BREAKER_THRESHOLD")) if threshold is None
             else threshold
         )
         self.cooldown_s = (
-            float(env("LHTPU_BREAKER_COOLDOWN_S", "30")) if cooldown_s is None
+            knobs.knob("LHTPU_BREAKER_COOLDOWN_S") if cooldown_s is None
             else cooldown_s
         )
         self._clock = clock
@@ -467,7 +457,7 @@ class FaultInjector:
         self._warned: set[str] = set()
 
     def _refresh_locked(self) -> None:
-        spec = os.environ.get("LHTPU_FAULT_INJECT", "")
+        spec = knobs.knob("LHTPU_FAULT_INJECT")
         if spec == self._spec:
             return
         self._spec = spec
@@ -489,7 +479,7 @@ class FaultInjector:
     def fire(self, stage: str) -> None:
         """Raise (or hang) if the spec has a live fault for ``stage``;
         no-op otherwise. The fast path (no env) is one dict read."""
-        if not os.environ.get("LHTPU_FAULT_INJECT"):
+        if not knobs.knob("LHTPU_FAULT_INJECT"):
             if self._spec:
                 with self._lock:
                     self._refresh_locked()
@@ -506,7 +496,7 @@ class FaultInjector:
                 return
         FAULTS_INJECTED.inc(stage=stage, kind=kind)
         if kind == "hang":
-            time.sleep(float(os.environ.get("LHTPU_FAULT_HANG_S", "3600")))
+            time.sleep(knobs.knob("LHTPU_FAULT_HANG_S"))
             return
         raise _FAULT_FACTORIES.get(
             kind, lambda: RuntimeError(f"injected fault: {kind}")
@@ -552,7 +542,7 @@ def force_with_deadline(fn, stage: str = "device_sync",
     ``hang`` kind exercises exactly this deadline. ``deadline_s`` <= 0
     runs inline (no thread, no guard)."""
     if deadline_s is None:
-        deadline_s = float(os.environ.get("LHTPU_SYNC_DEADLINE_S", "900"))
+        deadline_s = knobs.knob("LHTPU_SYNC_DEADLINE_S")
     if deadline_s <= 0:
         maybe_inject(stage)
         return fn()

@@ -1,0 +1,36 @@
+"""Canonical dispatch-stage vocabulary.
+
+One name grammar is shared by four subsystems that never import each
+other: the ``_stage(...)`` timing wrappers in the backend, the
+``bls_dispatch_stage_seconds{stage}`` metric labels, the resilience
+fault injector's ``LHTPU_FAULT_INJECT=stage:kind:count`` spec (and the
+soak chaos schedule layered on it), and the fault-drill / stage-profiler
+tools that enumerate stages from the outside. A typo in any one of them
+used to fail silently — an injected fault that never fires, a metric
+label that never aggregates. This tuple is the single source of truth;
+lint family LH3xx cross-checks every stage literal in the tree against
+it by AST (no import needed), so drift in any direction is an error.
+"""
+
+from __future__ import annotations
+
+CANONICAL_STAGES: tuple[str, ...] = (
+    # Host-side assembly, in hot-path order.
+    "pack",            # ints -> Montgomery limb grids
+    "hash_to_curve",   # messages -> G2 points (host or device HTC)
+    "scalars",         # RLC scalar sampling + bit decomposition
+    "msm_schedule",    # MSM bucket schedule build (fused path)
+    # Device phases.
+    "dispatch",          # program execution (async under the pipeline)
+    "sharded_dispatch",  # multi-chip variant routed by parallel/engine
+    "device_sync",       # verdict force / block_until_ready deadline
+    # Off-ladder stages.
+    "native_fallback",  # pure-CPU backend rung of the degradation ladder
+    "bench_device",     # bench.py's forced device probe dispatches
+)
+
+_STAGE_SET = frozenset(CANONICAL_STAGES)
+
+
+def is_canonical(name: str) -> bool:
+    return name in _STAGE_SET

@@ -37,12 +37,13 @@ import threading
 import time
 from collections import deque
 
+from . import knobs
 from .metrics import REGISTRY, Histogram
 
 #: finished ROOT spans kept for export (children ride their root)
 MAX_ROOT_SPANS = 256
 
-_enabled = os.environ.get("LHTPU_TRACE", "1") != "0"
+_enabled = bool(knobs.knob("LHTPU_TRACE"))
 
 
 def enabled() -> bool:

@@ -46,7 +46,7 @@ def _native():
             from ..native import load_lhsha
 
             _NATIVE = load_lhsha() or False
-        except Exception:
+        except Exception:  # lhtpu: ignore[LH502] -- native sha extension is optional; hashlib fallback is correct, just slower
             _NATIVE = False
     return _NATIVE
 

@@ -30,11 +30,10 @@ uses the wall clock for end-to-end latencies.
 from __future__ import annotations
 
 import hashlib
-import os
 import time
 from dataclasses import dataclass
 
-from ..common import health, pipeline
+from ..common import health, knobs, pipeline
 from ..crypto.bls import api as bls_api
 from ..network.processor import BATCHED, BeaconProcessor, WorkEvent, WorkType
 from . import slo
@@ -82,13 +81,6 @@ class VirtualClock:
             self._t = float(t)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 @dataclass
 class ServeConfig:
     batch_target: int = 256       # full-batch dispatch size
@@ -107,13 +99,14 @@ class ServeConfig:
         LHTPU_ADMIT_HIGH / LHTPU_ADMIT_LOW / LHTPU_SLO_BUDGET_MS, with
         explicit ``overrides`` winning."""
         cfg = {
-            "batch_target": int(_env_float("LHTPU_BATCH_TARGET", 256)),
-            "batch_deadline_ms": _env_float("LHTPU_BATCH_DEADLINE_MS", 250.0),
-            "admit_high": int(_env_float("LHTPU_ADMIT_HIGH", 8192)),
-            "slo_budget_ms": _env_float("LHTPU_SLO_BUDGET_MS", 4000.0),
+            "batch_target": int(knobs.knob("LHTPU_BATCH_TARGET")),
+            "batch_deadline_ms": knobs.knob("LHTPU_BATCH_DEADLINE_MS"),
+            "admit_high": int(knobs.knob("LHTPU_ADMIT_HIGH")),
+            "slo_budget_ms": knobs.knob("LHTPU_SLO_BUDGET_MS"),
         }
-        if "LHTPU_ADMIT_LOW" in os.environ:
-            cfg["admit_low"] = int(_env_float("LHTPU_ADMIT_LOW", 0))
+        admit_low = knobs.knob("LHTPU_ADMIT_LOW")
+        if admit_low is not None:
+            cfg["admit_low"] = int(admit_low)
         cfg.update(overrides)
         return cls(**cfg)
 

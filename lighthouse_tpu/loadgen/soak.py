@@ -47,7 +47,7 @@ import threading
 import time
 from dataclasses import dataclass, field, replace
 
-from ..common import health, monitoring, pipeline, resilience
+from ..common import health, knobs, monitoring, pipeline, resilience
 from .serve import ServeConfig, ServingLoop, VirtualClock, WallClock, \
     verdict_digest
 from .traffic import TrafficConfig, TrafficGenerator
@@ -99,13 +99,6 @@ def chaos_spec_for_epoch(schedule: list[ChaosEvent], epoch: int) -> str:
     )
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
-
 def _primary_rung() -> str:
     """The ladder's top rung on THIS host (fused only when the fused
     path is actually the configured primary — off-TPU it is classic)."""
@@ -113,7 +106,7 @@ def _primary_rung() -> str:
         from .. import jax_backend as jb
 
         return "fused" if jb._fused_choice() == "1" else "classic"
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- jax_backend can't load off-accelerator; ladder top defaults to fused
         return resilience.LADDER[0]
 
 
@@ -122,7 +115,7 @@ def _last_dispatch_path() -> str | None:
         from .. import jax_backend as jb
 
         return jb.dispatch_stage_report().get("path")
-    except Exception:
+    except Exception:  # lhtpu: ignore[LH502] -- dispatch path is diagnostic garnish; None when jax_backend can't load
         return None
 
 
@@ -143,22 +136,20 @@ class SoakConfig:
     backend: str | None = None
     wall_clock: bool = False          # default: deterministic virtual clock
     recovery_epochs: int = 2          # re-promotion budget after chaos
-    leak_mb: float | None = None      # None = LHTPU_SOAK_LEAK_MB (256)
+    leak_mb: float | None = None      # None = LHTPU_SOAK_LEAK_MB (512)
     watchdog_k: float | None = None   # None = LHTPU_SOAK_WATCHDOG_K (20)
-    watchdog_min_s: float | None = None  # None = ..._MIN_S (60)
+    watchdog_min_s: float | None = None  # None = ..._MIN_S (300)
     replay: bool = True               # chaos-free digest-parity replay
 
     def __post_init__(self):
         if self.leak_mb is None:
-            self.leak_mb = _env_float("LHTPU_SOAK_LEAK_MB", 512.0)
+            self.leak_mb = knobs.knob("LHTPU_SOAK_LEAK_MB")
         if self.watchdog_k is None:
-            self.watchdog_k = _env_float("LHTPU_SOAK_WATCHDOG_K", 20.0)
+            self.watchdog_k = knobs.knob("LHTPU_SOAK_WATCHDOG_K")
         if self.watchdog_min_s is None:
             # Must clear a cold XLA compile (minutes on CPU); real
             # wedges are caught anyway — just later. Tests shrink it.
-            self.watchdog_min_s = _env_float(
-                "LHTPU_SOAK_WATCHDOG_MIN_S", 300.0
-            )
+            self.watchdog_min_s = knobs.knob("LHTPU_SOAK_WATCHDOG_MIN_S")
 
 
 class SoakRunner:
@@ -171,7 +162,7 @@ class SoakRunner:
                  chaos: list[ChaosEvent] | None = None, emit=print):
         self.cfg = cfg
         self.chaos = list(chaos) if chaos is not None else (
-            parse_chaos_schedule(os.environ.get("LHTPU_CHAOS_SCHEDULE"))
+            parse_chaos_schedule(knobs.knob("LHTPU_CHAOS_SCHEDULE"))
         )
         self.emit = emit
 
@@ -261,7 +252,7 @@ class SoakRunner:
         cfg = self.cfg
         clock = WallClock() if cfg.wall_clock else VirtualClock()
         governor = health.governor()  # feeds note_slo from finish()
-        saved_inject = os.environ.get("LHTPU_FAULT_INJECT")
+        saved_inject = knobs.raw("LHTPU_FAULT_INJECT")
         epoch_rows: list[dict] = []
         crashed: str | None = None
         t_run0 = time.perf_counter()

@@ -326,7 +326,7 @@ class ClientBuilder:
                     try:
                         indexed, _ = chain._gossip_attestation_checks(ev.payload)
                         slasher.accept_attestation(indexed)
-                    except Exception:
+                    except Exception:  # lhtpu: ignore[LH502] -- structurally invalid gossip has nothing to slash on; gossip path already rejected it
                         pass  # structurally invalid: nothing to slash on
 
             def block_feeding(ev):

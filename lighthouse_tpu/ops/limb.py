@@ -299,9 +299,9 @@ def set_mont_mul_impl(name: str) -> None:
 
 
 def _impl() -> str:
-    import os
+    from ..common import knobs
 
-    if os.environ.get("LHTPU_PALLAS_MONT_MUL") == "1":
+    if knobs.knob("LHTPU_PALLAS_MONT_MUL"):
         return "pallas"
     return _MONT_MUL_IMPL
 

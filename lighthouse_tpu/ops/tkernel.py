@@ -41,6 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common import knobs as _knobs
 from ..crypto.bls.constants import P
 from . import limb as _limb
 from .limb import LIMB_BITS, LIMB_MASK, N_LIMBS, NINV8
@@ -320,7 +321,7 @@ def _ks_enabled() -> bool:
     dynamic_slice that Mosaic cannot lower (r4 BENCH recorded 0.0 sets/s
     with exactly that traceback). Re-enable with LHTPU_KS_CARRY=1 only
     after tools/lowering_smoke.py passes on TPU with the flag set."""
-    return _os.environ.get("LHTPU_KS_CARRY", "0") == "1"
+    return bool(_knobs.knob("LHTPU_KS_CARRY"))
 
 
 def _shift_rows(x, s: int, fill):
@@ -377,7 +378,7 @@ def _carry_norm_ks(t, bound: int):
     # negative-index/dynamic_slice Mosaic hazard forbidden above.
     assert rows >= 2, f"_carry_norm_ks needs >= 2 limb rows, got {rows}"
     top = rows - 1
-    if _os.environ.get("LHTPU_KS_CHECK") == "1":
+    if _knobs.knob("LHTPU_KS_CHECK"):
         bad = jnp.any((t < 0) | (t > bound))
         if not isinstance(bad, jax.core.Tracer):
             assert not bool(bad), (
@@ -486,11 +487,10 @@ _GROUP_LOWMEM = 2  # smaller windows where VMEM is tight (lowmem kernels)
 # both the fused batch verifier and the fused AggregateVerify — the
 # CIOS loop compiles fine). Decided lazily at trace time, not import
 # (tests flip the platform before first use).
-import os as _os
 
 
 def _mxu_fold_enabled() -> bool:
-    choice = _os.environ.get("LHTPU_MXU_FOLD")
+    choice = _knobs.knob("LHTPU_MXU_FOLD")
     if choice is not None:
         return choice == "1"
     return jax.default_backend() == "tpu"
@@ -509,7 +509,7 @@ def vmem_params():
         return None
     from jax.experimental.pallas import tpu as pltpu
 
-    mb = int(_os.environ.get("LHTPU_VMEM_LIMIT_MB", "64"))
+    mb = int(_knobs.knob("LHTPU_VMEM_LIMIT_MB"))
     return pltpu.CompilerParams(vmem_limit_bytes=mb * 1024 * 1024)
 
 

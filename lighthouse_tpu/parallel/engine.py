@@ -38,11 +38,10 @@ and every bench JSON line embed.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
 from ..utils import next_pow2
-from ..common import resilience
+from ..common import knobs, resilience
 from ..common.metrics import REGISTRY
 
 MESH_DEVICES = REGISTRY.gauge(
@@ -61,8 +60,6 @@ SHARDED_DISPATCHES = REGISTRY.counter(
 #: dimension — degrading it keeps the same rung on one chip).
 BREAKER = "sharded"
 
-DEFAULT_MIN_SETS_PER_CHIP = 4
-
 
 def _pow2_floor(n: int) -> int:
     return 1 if n < 1 else 1 << (n.bit_length() - 1)
@@ -71,10 +68,7 @@ def _pow2_floor(n: int) -> int:
 def min_sets_per_chip() -> int:
     """Auto-sharding threshold: shard only when every chip gets at
     least this many (real) sets (``LHTPU_SHARD_MIN_SETS``)."""
-    try:
-        return max(1, int(os.environ.get("LHTPU_SHARD_MIN_SETS", "")))
-    except ValueError:
-        return DEFAULT_MIN_SETS_PER_CHIP
+    return max(1, int(knobs.knob("LHTPU_SHARD_MIN_SETS")))
 
 
 @dataclass(frozen=True)
@@ -96,12 +90,9 @@ def topology() -> DeviceTopology:
     devs = jax.devices()
     visible = len(devs)
     n = visible
-    raw = os.environ.get("LHTPU_DEVICES")
-    if raw:
-        try:
-            n = min(n, max(1, int(raw)))
-        except ValueError:
-            pass
+    cap = knobs.knob("LHTPU_DEVICES")
+    if cap is not None:
+        n = min(n, max(1, int(cap)))
     return DeviceTopology(
         n_devices=_pow2_floor(n),
         visible=visible,
@@ -137,7 +128,7 @@ def plan(n_sets: int, S: int, *, n_groups: int | None = None,
     half-open probe slot is only consumed by a dispatch that would
     actually shard.
     """
-    shard = os.environ.get("LHTPU_SHARDED_VERIFY")
+    shard = knobs.knob("LHTPU_SHARDED_VERIFY")
     if shard == "0":
         return _single(S, n_sets, "disabled")
     if path_override is not None:
@@ -234,7 +225,7 @@ def chunk_floor() -> int:
     """Minimum pipeline chunk size so every microbatch chunk still
     spans the mesh at the min-sets-per-chip threshold; 1 when sharding
     would not engage (the pipeline policy then stays untouched)."""
-    shard = os.environ.get("LHTPU_SHARDED_VERIFY")
+    shard = knobs.knob("LHTPU_SHARDED_VERIFY")
     if shard == "0":
         return 1
     top = topology()

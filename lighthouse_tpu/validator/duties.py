@@ -68,7 +68,7 @@ class DutiesService:
                 data = self._call(
                     lambda c: c.get_validator("0x" + pubkey.hex())
                 )["data"]
-            except Exception:
+            except Exception:  # lhtpu: ignore[LH502] -- validator not yet known to the beacon node; re-polled next epoch
                 continue
             self.store.set_index(pubkey, int(data["index"]))
             known += 1
@@ -144,7 +144,7 @@ class DutiesService:
             self._call(
                 lambda c: c.post_beacon_committee_subscriptions(subs)
             )
-        except Exception:
+        except Exception:  # lhtpu: ignore[LH502] -- subnet subscription is advisory; duties proceed without it
             pass
 
     def _poll_proposers(self, epoch: int) -> None:

@@ -1,0 +1,2 @@
+"""Fixture: a waiver with no justification -> LH002."""
+x = 1  # lhtpu: ignore[LH501]

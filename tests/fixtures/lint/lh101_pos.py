@@ -1,0 +1,11 @@
+"""Fixture: time.* inside jit-traced code -> LH101."""
+import time
+import jax
+
+
+def traced(x):
+    time.sleep(0.001)
+    return x
+
+
+traced_jit = jax.jit(traced)

@@ -1,0 +1,11 @@
+"""Fixture: os.environ read inside jit-traced code -> LH102."""
+import os
+import jax
+
+
+def traced(x):
+    flavor = os.environ["PATH"]
+    return x if flavor else x
+
+
+traced_jit = jax.jit(traced)

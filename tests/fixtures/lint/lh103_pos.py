@@ -1,0 +1,10 @@
+"""Fixture: host RNG inside jit-traced code -> LH103."""
+import numpy as np
+import jax
+
+
+def traced(x):
+    return x + np.random.rand()
+
+
+traced_jit = jax.jit(traced)

@@ -1,0 +1,11 @@
+"""Fixture: .block_until_ready() inside jit-traced code -> LH104."""
+import jax
+
+
+def traced(x):
+    y = x * 2
+    y.block_until_ready()
+    return y
+
+
+traced_jit = jax.jit(traced)

@@ -1,0 +1,9 @@
+"""Fixture: float() on a likely tracer -> LH105."""
+import jax
+
+
+def traced(x):
+    return float(x)
+
+
+traced_jit = jax.jit(traced)

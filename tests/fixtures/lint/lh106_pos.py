@@ -1,0 +1,11 @@
+"""Fixture: Python branch on a likely tracer -> LH106."""
+import jax
+
+
+def traced(x):
+    if x:
+        return x * 2
+    return x
+
+
+traced_jit = jax.jit(traced)

@@ -1,0 +1,16 @@
+"""Fixture: pure traced code; static annotated param -> silent."""
+import jax
+import jax.numpy as jnp
+
+
+def helper(x):
+    return jnp.where(x > 0, x, -x)
+
+
+def traced(x, pad: int):
+    if pad:  # static config, documented by the annotation
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return helper(x)
+
+
+traced_jit = jax.jit(traced, static_argnums=(1,))

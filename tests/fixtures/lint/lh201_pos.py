@@ -1,0 +1,4 @@
+"""Fixture: raw env READ of a registered knob -> LH201."""
+import os
+
+trace_on = os.environ.get("LHTPU_TRACE", "1")

@@ -1,0 +1,8 @@
+"""Fixture: literal default alongside a registered knob -> LH202."""
+
+
+def configure(env_var, default_capacity):
+    return (env_var, default_capacity)
+
+
+CACHE = configure("LHTPU_PUBKEY_CACHE", 65536)

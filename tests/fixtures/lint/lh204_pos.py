@@ -1,0 +1,4 @@
+"""Fixture: knob() with an unregistered name -> LH204."""
+from lighthouse_tpu.common import knobs
+
+value = knobs.knob("LHTPU_NOT_A_REAL_KNOB")

@@ -1,0 +1,10 @@
+"""Fixture: registry reads + env WRITES -> silent (writes are legal)."""
+import os
+
+from lighthouse_tpu.common import knobs
+
+trace_on = knobs.knob("LHTPU_TRACE")
+raw_spec = knobs.raw("LHTPU_FAULT_INJECT")
+os.environ["LHTPU_TRACE"] = "0"
+os.environ.setdefault("LHTPU_TRACE", "1")
+os.environ.pop("LHTPU_TRACE", None)

@@ -1,0 +1,5 @@
+"""Fixture: non-canonical literal stage name -> LH301."""
+stages = {}
+
+with _stage("warp_drive", stages):  # noqa: F821
+    pass

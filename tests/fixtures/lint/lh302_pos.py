@@ -1,0 +1,4 @@
+"""Fixture: fault-inject literal naming an unknown stage -> LH302."""
+import os
+
+os.environ["LHTPU_FAULT_INJECT"] = "warp_drive:mosaic:1"

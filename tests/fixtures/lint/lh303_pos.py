@@ -1,0 +1,2 @@
+"""Fixture: *STAGES tuple containing a non-canonical stage -> LH303."""
+DRILL_STAGES = ("pack", "warp_drive")

@@ -1,0 +1,10 @@
+"""Fixture: canonical stage names everywhere -> silent."""
+import os
+
+stages = {}
+
+with _stage("dispatch", stages):  # noqa: F821
+    pass
+
+os.environ["LHTPU_FAULT_INJECT"] = "device_sync:mosaic:1"
+MY_STAGES = ("pack", "hash_to_curve")

@@ -1,0 +1,5 @@
+"""Fixture: verify core breaking the flat-arg order -> LH401."""
+
+
+def _verify_core_shuffled(sig, pk, pk_inf, sig_inf, msg, msg_inf, r_bits):
+    return pk

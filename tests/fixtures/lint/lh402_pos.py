@@ -1,0 +1,9 @@
+"""Fixture: a ladder variant with no grouped twin -> LH402."""
+import jax
+
+
+def f(x):
+    return x
+
+
+_verify_special_jit = jax.jit(f)

@@ -1,0 +1,15 @@
+"""Fixture: canonical order + complete grouped twins -> silent."""
+import jax
+
+
+def _verify_core_ok(pk, pk_inf, sig, sig_inf, msg, msg_inf, r_bits):
+    return pk
+
+
+def _verify_core_ok_grouped(pk, pk_inf, sig, sig_inf, msg, msg_inf,
+                            r_bits, group_ids):
+    return pk
+
+
+_verify_ok_jit = jax.jit(_verify_core_ok)
+_verify_ok_grouped_jit = jax.jit(_verify_core_ok_grouped)

@@ -1,0 +1,5 @@
+"""Fixture: bare except -> LH501."""
+try:
+    x = 1
+except:  # noqa: E722
+    x = 2

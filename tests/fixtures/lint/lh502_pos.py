@@ -1,0 +1,5 @@
+"""Fixture: broad except swallowing silently -> LH502."""
+try:
+    x = 1
+except Exception:
+    pass

@@ -1,0 +1,6 @@
+"""Fixture: mutable default argument -> LH503."""
+
+
+def accumulate(item, bucket=[]):
+    bucket.append(item)
+    return bucket

@@ -1,0 +1,30 @@
+"""Fixture: narrow except / recording handler / None default -> silent."""
+import sys
+
+
+def narrow():
+    try:
+        return 1
+    except ValueError:
+        return 0
+
+
+def recording():
+    try:
+        return 1
+    except Exception as exc:
+        sys.stderr.write(repr(exc))
+        return 0
+
+
+def waived():
+    try:
+        return 1
+    except Exception:  # lhtpu: ignore[LH502] -- fixture proves a justified waiver silences
+        return 0
+
+
+def safe_default(item, bucket=None):
+    bucket = [] if bucket is None else bucket
+    bucket.append(item)
+    return bucket

@@ -1,0 +1,4 @@
+"""Fixture: module-level RNG (unseeded) -> LH601."""
+import random
+
+jitter = random.random()

@@ -1,0 +1,4 @@
+"""Fixture: wall-clock read -> LH602."""
+import time
+
+stamp = time.time()

@@ -1,0 +1,8 @@
+"""Fixture: seeded RNG + monotonic clocks -> silent."""
+import random
+import time
+
+rng = random.Random(1234)
+jitter = rng.random()
+t0 = time.monotonic()
+dt = time.perf_counter() - t0

@@ -1,0 +1,127 @@
+"""lhtpu-lint: golden fixtures per check family + the shipped tree
+stays clean.
+
+Pure stdlib-AST — no JAX import, the whole module runs in seconds. The
+fixtures under tests/fixtures/lint/ are excluded from full-tree walks
+and linted only by explicit path here; each ``lhNNN_pos.py`` must
+raise exactly its own code, each ``lhN_neg.py`` must be silent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import LINT_VERSION, Finding, run_lint  # noqa: E402
+
+FIXTURE_DIR = os.path.join("tests", "fixtures", "lint")
+
+_FIXTURES = sorted(
+    name for name in os.listdir(os.path.join(REPO, FIXTURE_DIR))
+    if name.endswith(".py")
+)
+_POSITIVE = [n for n in _FIXTURES if not n.endswith("_neg.py")]
+_NEGATIVE = [n for n in _FIXTURES if n.endswith("_neg.py")]
+
+
+def _lint_fixture(name: str) -> list[Finding]:
+    return run_lint(REPO, files=[f"{FIXTURE_DIR}/{name}"])
+
+
+def test_fixture_inventory():
+    """Every family has at least one positive AND one negative."""
+    fams_pos = {n[:3] for n in _POSITIVE if n.startswith("lh")}
+    fams_neg = {n[:3] for n in _NEGATIVE}
+    # lh0 = waiver hygiene (its negative is the justified waiver
+    # inside lh5_neg.py)
+    assert {"lh1", "lh2", "lh3", "lh4", "lh5", "lh6"} <= fams_pos
+    assert {"lh1", "lh2", "lh3", "lh4", "lh5", "lh6"} <= fams_neg
+    assert "lh002_pos.py" in _POSITIVE
+
+
+@pytest.mark.parametrize("name", _POSITIVE)
+def test_fixture_fires_exactly_its_code(name):
+    expected = name.split("_")[0].upper()
+    findings = _lint_fixture(name)
+    assert findings, f"{name} produced no findings (want {expected})"
+    assert {f.code for f in findings} == {expected}, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("name", _NEGATIVE)
+def test_fixture_negative_is_silent(name):
+    findings = _lint_fixture(name)
+    assert not findings, [f.render() for f in findings]
+
+
+def test_waiver_requires_justification():
+    """LH002 is raised by core (family-independent) and is itself
+    unwaivable — the justified form in lh5_neg proves the silence."""
+    codes = {f.code for f in _lint_fixture("lh002_pos.py")}
+    assert codes == {"LH002"}
+
+
+def test_lint_clean():
+    """The shipped tree carries zero findings — every invariant holds
+    or is explicitly waived with a justification. This is the tier-1
+    gate the ISSUE demands; if this fails, either fix the regression
+    or waive it with an inline justification comment."""
+    findings = run_lint(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_json_clean_and_versioned():
+    """--json exits 0 on the shipped tree and carries the suite
+    version (the same one bench embeds as provenance)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == LINT_VERSION
+    assert payload["findings"] == []
+
+
+def test_cli_knob_table_matches_readme():
+    """The generated table and the checked-in README block agree
+    byte-for-byte (LH203 enforces the same thing in-process; this
+    proves the CLI path)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--knob-table"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    begin = readme.index("<!-- knob-table:begin")
+    begin = readme.index("-->", begin) + 3
+    end = readme.index("<!-- knob-table:end -->")
+    assert readme[begin:end].strip() == proc.stdout.strip()
+
+
+def test_changed_only_subset_runs():
+    """--changed-only never crashes and exits 0/1 like the full run
+    (an empty diff is the common CI case)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--changed-only"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode in (0, 1), proc.stderr
+
+
+def test_no_raw_lhtpu_reads_outside_registry():
+    """The ISSUE's acceptance bullet, asserted directly: zero LH201
+    findings anywhere in the tree (reads of LHTPU_* go through
+    lighthouse_tpu/common/knobs.py; writes stay free)."""
+    findings = [f for f in run_lint(REPO) if f.code == "LH201"]
+    assert findings == [], "\n".join(f.render() for f in findings)

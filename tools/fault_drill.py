@@ -29,9 +29,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-STAGES = (
-    "pack", "hash_to_curve", "scalars", "msm_schedule", "dispatch",
-    "device_sync",
+from lighthouse_tpu.common.stages import CANONICAL_STAGES  # noqa: E402
+
+#: the drillable subset of the canonical grammar: a new canonical stage
+#: joins the drill matrix automatically; the multi-chip/fallback/bench
+#: stages need topologies this host drill can't fake.
+STAGES = tuple(
+    s for s in CANONICAL_STAGES
+    if s not in ("sharded_dispatch", "native_fallback", "bench_device")
 )
 QUICK_STAGES = ("hash_to_curve", "dispatch", "device_sync")
 #: stages the grouped-triage path actually enters (it never builds an
@@ -124,7 +129,8 @@ def run_drill(stages=STAGES, kinds=KINDS, sets=None, backend=None,
                     verdict = backend.verify_signature_sets(sets)
                 except Exception as exc:  # contract breach, not a crash
                     verdict = None
-                    error = f"{type(exc).__name__}: {exc}"
+                    cat, kind_c = resilience.classify(exc)
+                    error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
                 finally:
                     os.environ.pop("LHTPU_FAULT_INJECT", None)
                 retries = _total(resilience.RETRIES_TOTAL) - retries0
@@ -224,7 +230,8 @@ def run_drill_triaged(stages=TRIAGE_STAGES, kinds=KINDS, backend=None):
                     verdict = backend.verify_signature_sets_triaged(sets)
                 except Exception as exc:  # contract breach, not a crash
                     verdict = None
-                    error = f"{type(exc).__name__}: {exc}"
+                    cat, kind_c = resilience.classify(exc)
+                    error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
                 finally:
                     os.environ.pop("LHTPU_FAULT_INJECT", None)
                 retries = _total(resilience.RETRIES_TOTAL) - retries0
@@ -344,7 +351,8 @@ def run_drill_slot_load(kinds=KINDS, backend=None):
                     ("p50_ms", "p99_ms", "shed", "dropped", "within_budget")
                 )
             except Exception as exc:  # contract breach, not a crash
-                error = f"{type(exc).__name__}: {exc}"
+                cat, kind_c = resilience.classify(exc)
+                error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
             finally:
                 os.environ.pop("LHTPU_FAULT_INJECT", None)
             retries = _total(resilience.RETRIES_TOTAL) - retries0
@@ -457,7 +465,8 @@ def run_drill_multichip(kinds=MULTICHIP_KINDS, backend=None):
                 verdict = backend.verify_signature_sets(sets)
             except Exception as exc:  # contract breach, not a crash
                 verdict = None
-                error = f"{type(exc).__name__}: {exc}"
+                cat, kind_c = resilience.classify(exc)
+                error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
             finally:
                 os.environ.pop("LHTPU_FAULT_INJECT", None)
             retries = _total(resilience.RETRIES_TOTAL) - retries0
@@ -565,7 +574,8 @@ def run_drill_soak():
             try:
                 res = SoakRunner(_cfg(replay), chaos=chaos, emit=None).run()
             except Exception as exc:  # contract breach, not a crash
-                error = f"{type(exc).__name__}: {exc}"
+                cat, kind_c = resilience.classify(exc)
+                error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
             retries = _total(resilience.RETRIES_TOTAL) - retries0
             degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
             if res is None:

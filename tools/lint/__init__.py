@@ -1,0 +1,22 @@
+"""lhtpu-lint — AST-based invariant checker for the dispatch matrix,
+env-knob registry, and jit-purity.
+
+Run as ``python -m tools.lint`` (``--json`` for machine-readable
+findings, ``--changed-only`` for the pre-commit subset,
+``--knob-table`` to regenerate the README knob table). Error-code
+families:
+
+==========  ==========================================================
+LH002       waiver without justification (not itself waivable)
+LH1xx       jit-purity (host impurity inside traced code)
+LH2xx       env-knob registry coherence
+LH3xx       stage/metric-name coherence
+LH4xx       program-builder signature contract
+LH5xx       resilience hygiene
+LH6xx       loadgen determinism
+==========  ==========================================================
+"""
+
+from .core import Finding, LINT_VERSION, changed_files, run_lint
+
+__all__ = ["Finding", "LINT_VERSION", "changed_files", "run_lint"]

@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.lint [--json] [--changed-only] [paths...]``.
+
+Exit status 0 = clean, 1 = findings (so it slots straight into CI).
+``--knob-table`` prints the generated README knob table and exits —
+paste it between the ``<!-- knob-table:begin/end -->`` markers (LH203
+fails the lint while the checked-in copy is stale).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import LINT_VERSION, changed_files, run_lint
+from .knobs_checks import load_knobs_module
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.lint",
+        description="lhtpu invariant checker (pure stdlib-ast; no JAX)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative .py files (default: whole tree)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files from git diff + untracked")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the generated README knob table and exit")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: cwd, or the tree "
+                         "containing this package)")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    if args.knob_table:
+        mod = load_knobs_module(root)
+        if mod is None:
+            print("error: could not load lighthouse_tpu/common/knobs.py",
+                  file=sys.stderr)
+            return 2
+        print(mod.knob_table_markdown())
+        return 0
+
+    files: list[str] | None = None
+    if args.paths:
+        files = args.paths
+    elif args.changed_only:
+        files = changed_files(root)
+        if not files:
+            if args.as_json:
+                print(json.dumps({"version": LINT_VERSION,
+                                  "findings": []}))
+            else:
+                print("lhtpu-lint: no changed .py files")
+            return 0
+
+    findings = run_lint(root, files=files)
+
+    if args.as_json:
+        print(json.dumps({
+            "version": LINT_VERSION,
+            "findings": [fi.as_dict() for fi in findings],
+        }, indent=2))
+    else:
+        for fi in findings:
+            print(fi.render())
+        print(f"lhtpu-lint {LINT_VERSION}: "
+              f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

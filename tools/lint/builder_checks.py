@@ -1,0 +1,114 @@
+"""LH4xx — program-builder signature contract.
+
+The dispatch matrix hands the same flat-arg tuple to every program
+variant, so a builder whose signature drifts out of canonical order
+compiles fine and verifies GARBAGE (args silently transposed). Two
+checks pin the contract:
+
+* LH401  a ``_verify_core*`` def (jax_backend) or the inner
+         ``body``/``fn`` of a ``build_sharded_*_verifier`` builder does
+         not START with the canonical flat-arg prefix (fused variants
+         append extra operands after it — only the prefix is pinned)
+* LH402  a dispatch-ladder variant has no grouped twin: for every
+         non-grouped ``build_sharded_*_verifier`` builder /
+         ``_verify*_jit`` program there must be a sibling whose name is
+         exactly the same tokens + ``grouped`` (waive for genuinely
+         groupless variants)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Ctx, FileCtx
+
+SCOPE = {
+    "lighthouse_tpu/jax_backend.py",
+    "lighthouse_tpu/parallel/sharding.py",
+}
+
+#: jax_backend core verifiers
+CORE_PREFIX = ("pk", "pk_inf", "sig", "sig_inf", "msg", "msg_inf",
+               "r_bits")
+#: sharded plain bodies (split affine planes)
+PLAIN_PREFIX = ("pk_x", "pk_y", "pk_inf", "sx", "sy", "sinf",
+                "mx", "my", "minf", "r_bits")
+#: sharded indexed bodies (pubkey table + gather indices)
+INDEXED_PREFIX = ("tx", "ty", "idx", "pk_inf", "sx", "sy", "sinf",
+                  "mx", "my", "minf", "r_bits")
+
+
+def _params(fn) -> tuple[str, ...]:
+    a = fn.args
+    return tuple(arg.arg for arg in (a.posonlyargs + a.args))
+
+
+def _check_prefix(ctx: Ctx, f: FileCtx, fn, want: tuple[str, ...],
+                  what: str) -> None:
+    got = _params(fn)
+    if got[:len(want)] != want:
+        ctx.add(
+            f, fn.lineno, "LH401",
+            f"{what} {fn.name!r} breaks the canonical flat-arg order: "
+            f"got ({', '.join(got[:len(want)])}), want "
+            f"({', '.join(want)}) — the dispatch matrix passes "
+            f"positionally",
+        )
+
+
+def _tokens(name: str) -> frozenset[str]:
+    return frozenset(t for t in name.split("_") if t)
+
+
+def _check_twins(ctx: Ctx, f: FileCtx, names: list[tuple[str, int]],
+                 what: str) -> None:
+    """Every non-grouped variant needs a grouped sibling with the exact
+    same token set + ``grouped``."""
+    have = {_tokens(n) for n, _ in names}
+    for name, lineno in names:
+        toks = _tokens(name)
+        if "grouped" in toks:
+            continue
+        if toks | {"grouped"} not in have:
+            ctx.add(
+                f, lineno, "LH402",
+                f"{what} {name!r} has no grouped twin "
+                f"({'_'.join(sorted(toks | {'grouped'}))} variant "
+                f"missing) — grouped verdicts are a dispatch "
+                f"dimension, not an option",
+            )
+
+
+def _check_file(ctx: Ctx, f: FileCtx) -> None:
+    builders: list[tuple[str, int]] = []
+    programs: list[tuple[str, int]] = []
+
+    for node in f.tree.body:
+        if isinstance(node, ast.FunctionDef):
+            if (node.name.startswith("_verify_core")):
+                _check_prefix(ctx, f, node, CORE_PREFIX,
+                              "core verifier")
+            elif (node.name.startswith("build_sharded")
+                  and node.name.endswith("_verifier")):
+                builders.append((node.name, node.lineno))
+                want = (INDEXED_PREFIX if "indexed" in _tokens(node.name)
+                        else PLAIN_PREFIX)
+                for inner in ast.walk(node):
+                    if (isinstance(inner, ast.FunctionDef)
+                            and inner.name in ("body", "fn")):
+                        _check_prefix(ctx, f, inner, want,
+                                      "sharded body")
+        elif (isinstance(node, ast.Assign) and node.targets
+              and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            if name.startswith("_verify") and name.endswith("_jit"):
+                programs.append((name, node.lineno))
+
+    _check_twins(ctx, f, builders, "builder")
+    _check_twins(ctx, f, programs, "program")
+
+
+def run(ctx: Ctx) -> None:
+    for f in ctx.files:
+        if f.rel in SCOPE or f.fixture_family == "lh4":
+            _check_file(ctx, f)

@@ -1,0 +1,92 @@
+"""LH6xx — loadgen determinism.
+
+The traffic generator's whole contract is "same seed, same mainnet
+slice, same digests" (soak compares epoch digests across runs; CI
+compares them across versions). One unseeded RNG or wall-clock read in
+the generation path quietly breaks replayability:
+
+* LH601  unseeded randomness — module-level ``random.*`` calls,
+         ``random.Random()`` with no seed, legacy ``np.random.*``
+         globals, ``np.random.default_rng()`` with no seed
+* LH602  wall-clock read — ``time.time()``, ``datetime.now()`` and
+         friends. ``time.monotonic``/``perf_counter`` stay legal: they
+         measure duration, they don't enter digests.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Ctx, FileCtx
+
+_SCOPE_PREFIX = "lighthouse_tpu/loadgen/"
+
+_WALL_CLOCK_TIME = {"time", "ctime", "localtime", "gmtime", "strftime"}
+_WALL_CLOCK_DT = {"now", "utcnow", "today"}
+
+
+def _check_file(ctx: Ctx, f: FileCtx) -> None:
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        base = fn.value
+        # random.<fn>() on the module — the hidden global Mersenne
+        # Twister; random.Random(seed) is the blessed form
+        if isinstance(base, ast.Name) and base.id == "random":
+            if fn.attr == "Random":
+                if not node.args and not node.keywords:
+                    ctx.add(
+                        f, node.lineno, "LH601",
+                        "random.Random() without a seed — loadgen "
+                        "must replay from cfg.seed",
+                    )
+            elif fn.attr[:1].islower():
+                ctx.add(
+                    f, node.lineno, "LH601",
+                    f"module-level random.{fn.attr}() uses the hidden "
+                    f"global RNG — thread a random.Random(seed)",
+                )
+        # np.random.<fn>() — legacy global, or unseeded default_rng()
+        elif (isinstance(base, ast.Attribute) and base.attr == "random"
+              and isinstance(base.value, ast.Name)
+              and base.value.id in ("np", "numpy")):
+            if fn.attr == "default_rng":
+                if not node.args and not node.keywords:
+                    ctx.add(
+                        f, node.lineno, "LH601",
+                        "np.random.default_rng() without a seed",
+                    )
+            else:
+                ctx.add(
+                    f, node.lineno, "LH601",
+                    f"legacy np.random.{fn.attr}() global RNG — use a "
+                    f"seeded Generator",
+                )
+        # time.time() and friends
+        elif (isinstance(base, ast.Name) and base.id == "time"
+              and fn.attr in _WALL_CLOCK_TIME):
+            ctx.add(
+                f, node.lineno, "LH602",
+                f"wall-clock time.{fn.attr}() in loadgen — use "
+                f"time.monotonic()/perf_counter() (durations) or a "
+                f"seeded virtual clock (digests)",
+            )
+        # datetime.now()/utcnow()/today()
+        elif (fn.attr in _WALL_CLOCK_DT
+              and isinstance(base, (ast.Name, ast.Attribute))
+              and (base.id if isinstance(base, ast.Name)
+                   else base.attr) in ("datetime", "date")):
+            ctx.add(
+                f, node.lineno, "LH602",
+                f"wall-clock datetime {fn.attr}() in loadgen",
+            )
+
+
+def run(ctx: Ctx) -> None:
+    for f in ctx.files:
+        if (f.rel.startswith(_SCOPE_PREFIX)
+                or f.fixture_family == "lh6"):
+            _check_file(ctx, f)

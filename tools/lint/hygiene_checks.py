@@ -1,0 +1,97 @@
+"""LH5xx — resilience hygiene.
+
+The resilience layer only works when failures actually REACH it: a
+handler that eats an exception without recording anything starves the
+breaker/classifier of the signal it exists to consume.
+
+* LH501  bare ``except:`` — catches KeyboardInterrupt/SystemExit too
+* LH502  ``except Exception/BaseException`` whose body neither
+         re-raises nor CALLS anything — a pure swallow (``pass``,
+         ``return None``, constant assignment). Handlers that record
+         (metric bump, classify, log write) pass; genuinely best-effort
+         swallows carry a waiver with the justification.
+* LH503  mutable default argument (``def f(x=[])``) — shared across
+         calls, a classic slow corruption
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Ctx, FileCtx
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [el.id for el in t.elts if isinstance(el, ast.Name)]
+    return any(n in _BROAD for n in names)
+
+
+def _body_acts(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, calls ANYTHING, or stores the
+    bound exception somewhere (``box["error"] = exc``) — i.e. the
+    failure leaves a trace."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            return True
+        if (handler.name and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True
+    return False
+
+
+def _mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set"))
+
+
+def _check_file(ctx: Ctx, f: FileCtx) -> None:
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                ctx.add(
+                    f, node.lineno, "LH501",
+                    "bare 'except:' — also catches "
+                    "KeyboardInterrupt/SystemExit; name the exception "
+                    "(at minimum 'except Exception')",
+                )
+            elif _catches_broad(node) and not _body_acts(node):
+                ctx.add(
+                    f, node.lineno, "LH502",
+                    "broad except swallows the failure without "
+                    "recording anything — route it (resilience."
+                    "classify, a metric bump, a log line) or waive "
+                    "with justification",
+                )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = (list(node.args.defaults)
+                        + [d for d in node.args.kw_defaults
+                           if d is not None])
+            for d in defaults:
+                if _mutable_default(d):
+                    ctx.add(
+                        f, d.lineno, "LH503",
+                        f"mutable default argument in {node.name!r} — "
+                        f"shared across calls; use None + init in body",
+                    )
+
+
+def run(ctx: Ctx) -> None:
+    for f in ctx.files:
+        # test code swallows on purpose constantly (pytest.raises
+        # scaffolding, teardown best-effort); hold shipped code + the
+        # tools layer + the lh5 fixtures to the standard
+        if (f.rel.startswith("tests/")
+                and f.fixture_family != "lh5"):
+            continue
+        _check_file(ctx, f)

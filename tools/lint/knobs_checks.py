@@ -1,0 +1,224 @@
+"""LH2xx — env-knob registry coherence.
+
+* LH201  raw ``os.environ``/``os.getenv`` READ of a literal ``LHTPU_*``
+         name outside ``lighthouse_tpu/common/knobs.py``. Writes
+         (assignment, ``setdefault``, ``pop``, ``del``) stay legal —
+         tests and drills must still be able to flip knobs; only the
+         *parse* must be centralized.
+* LH202  a literal ``LHTPU_*`` name passed together with a literal
+         default to anything but the registry accessors — a second
+         declaration of a default that already lives in the registry.
+* LH203  the README knob table no longer matches
+         ``knob_table_markdown()`` (regenerate with
+         ``python -m tools.lint --knob-table``). Full-tree mode only.
+* LH204  ``knob(...)``/``raw(...)`` called with an unregistered literal
+         name (would KeyError at runtime / silently bypass typing).
+* LH205  a registered knob whose name appears in no consumer file —
+         a dead knob rotting in the registry. Full-tree mode only.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+
+from .core import Ctx, FileCtx
+
+KNOBS_REL = "lighthouse_tpu/common/knobs.py"
+README_REL = "README.md"
+TABLE_BEGIN = "<!-- knob-table:begin (generated: python -m tools.lint --knob-table) -->"
+TABLE_END = "<!-- knob-table:end -->"
+
+#: registry accessors — literal names passed to these are the POINT,
+#: not a duplication
+_ACCESSORS = {"knob", "raw", "maybe_int", "scoped_env"}
+
+
+def load_knobs_module(root: str):
+    """Execute knobs.py in isolation (stdlib-only module; no package
+    import, no JAX) and return it, or None when absent/broken."""
+    path = os.path.join(root, KNOBS_REL)
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("_lhtpu_knobs", path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolves annotations through sys.modules during
+        # exec — register for the duration, then drop
+        sys.modules["_lhtpu_knobs"] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop("_lhtpu_knobs", None)
+        return mod
+    except Exception as exc:
+        sys.stderr.write(f"lhtpu-lint: knobs.py failed to load: {exc!r}; "
+                         f"LH2xx registry checks degraded\n")
+        return None
+
+
+def _is_lhtpu_literal(node) -> str | None:
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("LHTPU_")):
+        return node.value
+    return None
+
+
+def _is_environ(node) -> bool:
+    """``os.environ`` (or any ``<x>.environ``) attribute access."""
+    return isinstance(node, ast.Attribute) and node.attr == "environ"
+
+
+def _check_file(ctx: Ctx, f: FileCtx, registered: set[str],
+                check_duplicated_defaults: bool) -> None:
+    for node in ast.walk(f.tree):
+        # -- LH201: reads -------------------------------------------------
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = None
+            if node.args:
+                name = _is_lhtpu_literal(node.args[0])
+            if name and isinstance(fn, ast.Attribute):
+                # os.environ.get("LHTPU_X"[, d]) — a read.
+                # pop/setdefault mutate the env: they are the
+                # write-side API tests/drills legitimately use.
+                if _is_environ(fn.value) and fn.attr == "get":
+                    ctx.add(
+                        f, node.lineno, "LH201",
+                        f"raw os.environ read of {name!r}; use "
+                        f"knobs.knob()/knobs.raw() (registry: {KNOBS_REL})",
+                    )
+                # os.getenv("LHTPU_X")
+                elif fn.attr == "getenv":
+                    ctx.add(
+                        f, node.lineno, "LH201",
+                        f"raw os.getenv read of {name!r}; use "
+                        f"knobs.knob()/knobs.raw()",
+                    )
+            # -- LH202/LH204: literal name into a helper ------------------
+            callee = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name and callee in ("knob", "raw") and name not in registered:
+                ctx.add(
+                    f, node.lineno, "LH204",
+                    f"knobs.{callee}({name!r}): name not in the "
+                    f"registry — register it in {KNOBS_REL}",
+                )
+            elif (
+                check_duplicated_defaults
+                and callee is not None
+                and callee not in _ACCESSORS
+                and not (isinstance(fn, ast.Attribute)
+                         and (_is_environ(fn.value) or fn.attr == "getenv"))
+            ):
+                # any registered literal name + any sibling literal
+                # constant = a default declared outside the registry
+                lh_args = [
+                    v for a in node.args
+                    if (v := _is_lhtpu_literal(a)) and v in registered
+                ]
+                # a duplicated default is a NUMBER/bool riding along;
+                # sibling strings (cache names, doc) are fine
+                others = [
+                    a for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, (bool, int, float))
+                ]
+                if lh_args and others:
+                    ctx.add(
+                        f, node.lineno, "LH202",
+                        f"{callee}({lh_args[0]!r}, ...) passes a literal "
+                        f"default alongside a registered knob name — the "
+                        f"default belongs in {KNOBS_REL} only",
+                    )
+        # -- LH201: subscript read os.environ["LHTPU_X"] ------------------
+        elif isinstance(node, ast.Subscript):
+            name = _is_lhtpu_literal(node.slice)
+            if (name and _is_environ(node.value)
+                    and isinstance(node.ctx, ast.Load)):
+                ctx.add(
+                    f, node.lineno, "LH201",
+                    f"raw os.environ[{name!r}] read; use knobs.knob()",
+                )
+        # -- LH201: membership test "LHTPU_X" in os.environ ---------------
+        elif isinstance(node, ast.Compare):
+            name = _is_lhtpu_literal(node.left)
+            if name and any(
+                isinstance(op, (ast.In, ast.NotIn)) and _is_environ(cmp)
+                for op, cmp in zip(node.ops, node.comparators)
+            ):
+                ctx.add(
+                    f, node.lineno, "LH201",
+                    f"membership test {name!r} in os.environ; use "
+                    f"knobs.raw({name!r}) is not None",
+                )
+
+
+def run(ctx: Ctx) -> None:
+    mod = load_knobs_module(ctx.root)
+    registered: set[str] = set(mod.REGISTRY) if mod is not None else set()
+
+    for f in ctx.files:
+        if f.rel == KNOBS_REL:
+            continue
+        if f.in_fixture_dir and f.fixture_family != "lh2":
+            continue
+        # tests legitimately re-declare values via monkeypatch.setenv;
+        # only non-test code is held to single-declaration (fixtures
+        # opt back in so the golden test can exercise LH202)
+        dup = not f.rel.startswith("tests/") or f.in_fixture_dir
+        _check_file(ctx, f, registered, dup)
+
+    if not ctx.full_tree or mod is None:
+        return
+
+    # -- LH203: README table staleness ------------------------------------
+    readme_path = os.path.join(ctx.root, README_REL)
+    try:
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            readme = fh.read()
+    except OSError:
+        readme = ""
+    begin, end = readme.find(TABLE_BEGIN), readme.find(TABLE_END)
+    anchor = FileCtx(ctx.root, README_REL, "")  # waivers n/a for .md
+    if begin < 0 or end < 0 or end < begin:
+        ctx.add(
+            anchor, 1, "LH203",
+            f"README is missing the generated knob table between "
+            f"{TABLE_BEGIN!r} and {TABLE_END!r} markers",
+        )
+    else:
+        checked_in = readme[begin + len(TABLE_BEGIN):end].strip()
+        line = readme[:begin].count("\n") + 1
+        if checked_in != mod.knob_table_markdown().strip():
+            ctx.add(
+                anchor, line, "LH203",
+                "README knob table is stale — regenerate with "
+                "'python -m tools.lint --knob-table' and paste between "
+                "the markers",
+            )
+
+    # -- LH205: dead knobs -------------------------------------------------
+    knobs_ctx = ctx.by_rel(KNOBS_REL)
+    for name, k in mod.REGISTRY.items():
+        quoted = (f'"{name}"', f"'{name}'")
+        alive = any(
+            f.rel != KNOBS_REL and any(q in f.source for q in quoted)
+            for f in ctx.files
+        )
+        if not alive and knobs_ctx is not None:
+            line = next(
+                (i for i, text in
+                 enumerate(knobs_ctx.source.splitlines(), start=1)
+                 if f'"{name}"' in text),
+                1,
+            )
+            ctx.add(
+                knobs_ctx, line, "LH205",
+                f"registered knob {name} has no consumer (no file "
+                f"mentions it) — delete it or wire it up",
+            )

@@ -1,0 +1,215 @@
+"""LH1xx — jit-purity.
+
+Roots are functions handed to ``jax.jit`` / ``shard_map`` /
+``pallas_call`` (direct call, wrapped call like
+``jax.jit(_gathered(_verify_core))``, or via a ``@jax.jit`` /
+``@partial(shard_map, ...)`` decorator). From each root we BFS the
+MODULE-LOCAL call graph (cross-module helpers are linted when their own
+module's roots reach them) and flag host-side impurity inside anything
+reachable:
+
+* LH101  ``time.*`` call — wall-clock baked in at trace time
+* LH102  ``os.environ`` / ``os.getenv`` — env read under trace caches
+         one process's env forever
+* LH103  ``np.random.*`` / module-level ``random.*`` — host RNG inside
+         traced code is a silent constant after the first trace
+* LH104  ``.block_until_ready()`` — host sync inside a program
+* LH105  ``float()/int()/bool()`` on a parameter — concretizes a tracer
+* LH106  ``if``/``while`` on a bare parameter — Python branching on a
+         tracer (use ``jnp.where``/``lax.cond``)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Ctx, FileCtx
+
+#: callables whose function argument becomes traced code
+_JIT_NAMES = {"jit", "shard_map", "pallas_call"}
+
+_SCOPE_PREFIX = "lighthouse_tpu/"
+
+
+def _callee_tail(fn) -> str | None:
+    """Last attribute/name of a callee: ``jax.jit`` -> ``jit``."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _root_names_from_call(node: ast.Call) -> list[str]:
+    """Function names traced by a jit-ish call site."""
+    if _callee_tail(node.func) not in _JIT_NAMES:
+        return []
+    out: list[str] = []
+    for arg in node.args[:1]:  # the traced callable is always arg 0
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Call):
+            # jax.jit(_gathered(_verify_core)): the wrapper closes over
+            # its Name arguments, which end up traced too
+            if (name := _callee_tail(arg.func)) is not None:
+                out.append(name)
+            out.extend(a.id for a in arg.args if isinstance(a, ast.Name))
+    return out
+
+
+def _is_jit_decorator(dec) -> bool:
+    tail = _callee_tail(dec)
+    if tail in _JIT_NAMES:
+        return True
+    # @partial(jax.jit, ...) / @partial(shard_map, mesh=...)
+    if (isinstance(dec, ast.Call) and _callee_tail(dec.func) == "partial"
+            and dec.args and _callee_tail(dec.args[0]) in _JIT_NAMES):
+        return True
+    if isinstance(dec, ast.Call):
+        return _callee_tail(dec.func) in _JIT_NAMES
+    return False
+
+
+def _collect(f: FileCtx):
+    """(name -> FunctionDef table, root function names) for one file."""
+    table: dict[str, ast.AST] = {}
+    roots: set[str] = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table[node.name] = node
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            roots.update(_root_names_from_call(node))
+    return table, roots
+
+
+def _reachable(table: dict[str, ast.AST], roots: set[str]) -> set[str]:
+    seen: set[str] = set()
+    frontier = [r for r in roots if r in table]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for node in ast.walk(table[name]):
+            if isinstance(node, ast.Call):
+                callee = _callee_tail(node.func)
+                if callee in table and callee not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+_STATIC_ANNOTATIONS = {"int", "float", "bool", "str"}
+
+
+def _param_names(fn) -> set[str]:
+    """Parameters treated as likely tracers. A plain-Python annotation
+    (``pad: int``, ``xm1: bool``) documents a STATIC config argument —
+    jit marks those static or closes over them — so annotated params
+    are exempt from the coercion/branching checks."""
+    a = fn.args
+    out = set()
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        ann = arg.annotation
+        if (isinstance(ann, ast.Name)
+                and ann.id in _STATIC_ANNOTATIONS):
+            continue
+        out.add(arg.arg)
+    return out
+
+
+def _bare_param(node, params: set[str]) -> str | None:
+    if isinstance(node, ast.Name) and node.id in params:
+        return node.id
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not)):
+        return _bare_param(node.operand, params)
+    return None
+
+
+def _check_function(ctx: Ctx, f: FileCtx, fn, via: str) -> None:
+    params = _param_names(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = node.func
+            if (isinstance(callee, ast.Attribute)
+                    and isinstance(callee.value, ast.Name)):
+                mod, attr = callee.value.id, callee.attr
+                if mod == "time":
+                    ctx.add(
+                        f, node.lineno, "LH101",
+                        f"time.{attr}() inside jit-traced {fn.name!r} "
+                        f"(root: {via}) — trace-time wall clock",
+                    )
+                elif mod == "os" and attr == "getenv":
+                    ctx.add(
+                        f, node.lineno, "LH102",
+                        f"os.getenv inside jit-traced {fn.name!r} "
+                        f"(root: {via}) — env read baked into the trace",
+                    )
+                elif mod == "random":
+                    ctx.add(
+                        f, node.lineno, "LH103",
+                        f"random.{attr}() inside jit-traced {fn.name!r} "
+                        f"(root: {via}) — host RNG becomes a trace "
+                        f"constant",
+                    )
+            if (isinstance(callee, ast.Attribute)
+                    and callee.attr == "block_until_ready"):
+                ctx.add(
+                    f, node.lineno, "LH104",
+                    f".block_until_ready() inside jit-traced "
+                    f"{fn.name!r} (root: {via}) — host sync in program",
+                )
+            # np.random.<anything>(...)
+            if (isinstance(callee, ast.Attribute)
+                    and isinstance(callee.value, ast.Attribute)
+                    and callee.value.attr == "random"
+                    and isinstance(callee.value.value, ast.Name)
+                    and callee.value.value.id in ("np", "numpy")):
+                ctx.add(
+                    f, node.lineno, "LH103",
+                    f"np.random.{callee.attr}() inside jit-traced "
+                    f"{fn.name!r} (root: {via})",
+                )
+            # float(x)/int(x)/bool(x) where x is a parameter
+            if (isinstance(callee, ast.Name)
+                    and callee.id in ("float", "int", "bool")
+                    and len(node.args) == 1):
+                p = _bare_param(node.args[0], params)
+                if p is not None:
+                    ctx.add(
+                        f, node.lineno, "LH105",
+                        f"{callee.id}({p}) inside jit-traced "
+                        f"{fn.name!r} (root: {via}) — concretizes a "
+                        f"tracer (ConcretizationTypeError on TPU)",
+                    )
+        elif isinstance(node, (ast.If, ast.While)):
+            p = _bare_param(node.test, params)
+            if p is not None:
+                kw = "while" if isinstance(node, ast.While) else "if"
+                ctx.add(
+                    f, node.lineno, "LH106",
+                    f"{kw} {p}: inside jit-traced {fn.name!r} "
+                    f"(root: {via}) — Python branch on a tracer; use "
+                    f"jnp.where/lax.cond",
+                )
+        # os.environ access anywhere in the body
+        elif (isinstance(node, ast.Attribute) and node.attr == "environ"
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "os"):
+            ctx.add(
+                f, node.lineno, "LH102",
+                f"os.environ access inside jit-traced {fn.name!r} "
+                f"(root: {via})",
+            )
+
+
+def run(ctx: Ctx) -> None:
+    for f in ctx.files:
+        if not (f.rel.startswith(_SCOPE_PREFIX)
+                or f.fixture_family == "lh1"):
+            continue
+        table, roots = _collect(f)
+        for name in sorted(_reachable(table, roots)):
+            _check_function(ctx, f, table[name], via=name)

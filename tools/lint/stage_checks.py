@@ -1,0 +1,187 @@
+"""LH3xx — stage/metric-name coherence.
+
+The stage grammar lives in ``lighthouse_tpu/common/stages.py``
+(``CANONICAL_STAGES``); four subsystems consume it — the dispatch
+timers (``_stage``/``_retry_stage``), the
+``bls_dispatch_stage_seconds{stage}`` /
+``bls_dispatch_errors_total{stage}`` metric labels, the resilience
+fault-injection spec (``LHTPU_FAULT_INJECT=stage:kind:count``), and the
+soak chaos schedule (``epoch:stage:kind:count``). A typo'd stage name
+silently times nothing / injects nothing, so every LITERAL stage string
+is cross-checked here:
+
+* LH301  literal stage argument (positional to
+         ``_stage``/``_retry_stage``/``maybe_inject``, or any
+         ``stage=`` keyword) not in the canonical list
+* LH302  fault-inject / chaos-schedule literal whose stage token is
+         not canonical
+* LH303  a module-level ``*STAGES`` tuple/list containing a
+         non-canonical stage
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Ctx, FileCtx
+
+STAGES_REL = "lighthouse_tpu/common/stages.py"
+
+#: callables whose first positional argument is a stage name
+_STAGE_ARG0 = {"_stage", "_retry_stage", "maybe_inject"}
+
+
+def canonical_stages(ctx: Ctx) -> frozenset[str]:
+    """CANONICAL_STAGES read straight off the AST of stages.py — the
+    linter never imports analyzed code."""
+    f = ctx.by_rel(STAGES_REL)
+    if f is None:
+        try:
+            import os
+            with open(os.path.join(ctx.root, STAGES_REL),
+                      encoding="utf-8") as fh:
+                f = FileCtx(ctx.root, STAGES_REL, fh.read())
+        except (OSError, SyntaxError):
+            return frozenset()
+    for node in ast.walk(f.tree):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):  # CANONICAL_STAGES: tuple
+            target = node.target
+        if (target is not None and isinstance(target, ast.Name)
+                and target.id == "CANONICAL_STAGES"
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return frozenset(
+                el.value for el in node.value.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)
+            )
+    return frozenset()
+
+
+def _callee_tail(fn) -> str | None:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _str_const(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _leading_literal(node) -> str | None:
+    """The literal prefix of a spec expression: plain string constant,
+    or the first constant piece of an f-string
+    (``f"dispatch:{kind}:1"`` -> ``"dispatch:"``)."""
+    if (s := _str_const(node)) is not None:
+        return s
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return _str_const(node.values[0])
+    return None
+
+
+def _check_spec(ctx: Ctx, f: FileCtx, lineno: int, env: str,
+                literal: str, canon: frozenset[str]) -> None:
+    """Validate stage tokens in a FAULT_INJECT/CHAOS_SCHEDULE literal."""
+    stage_index = 0 if env == "LHTPU_FAULT_INJECT" else 1
+    for item in filter(None, (p.strip() for p in literal.split(";"))):
+        for sub in filter(None, (p.strip() for p in item.split(","))):
+            fields = sub.split(":")
+            if len(fields) <= stage_index:
+                continue  # partial f-string prefix without the token
+            stage = fields[stage_index]
+            if stage and stage not in canon:
+                ctx.add(
+                    f, lineno, "LH302",
+                    f"{env} literal names unknown stage {stage!r} "
+                    f"(canonical: {', '.join(sorted(canon))})",
+                )
+
+
+def _spec_env_name(target) -> str | None:
+    """``os.environ["LHTPU_FAULT_INJECT"]`` assignment target -> env."""
+    if (isinstance(target, ast.Subscript)
+            and isinstance(target.value, ast.Attribute)
+            and target.value.attr == "environ"):
+        name = _str_const(target.slice)
+        if name in ("LHTPU_FAULT_INJECT", "LHTPU_CHAOS_SCHEDULE"):
+            return name
+    return None
+
+
+def run(ctx: Ctx) -> None:
+    canon = canonical_stages(ctx)
+    if not canon:
+        return
+
+    for f in ctx.files:
+        if f.rel == STAGES_REL:
+            continue
+        # tests exercise the machinery with made-up stage names on
+        # purpose; only shipped code + lh3 fixtures are held to the
+        # grammar
+        if (f.rel.startswith("tests/")
+                and f.fixture_family != "lh3"):
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                # positional stage arg
+                if (_callee_tail(node.func) in _STAGE_ARG0
+                        and node.args):
+                    s = _str_const(node.args[0])
+                    if s is not None and s not in canon:
+                        ctx.add(
+                            f, node.lineno, "LH301",
+                            f"stage {s!r} is not canonical (see "
+                            f"{STAGES_REL})",
+                        )
+                # stage= keyword anywhere (metric labels, retries)
+                for kw in node.keywords:
+                    if kw.arg == "stage":
+                        s = _str_const(kw.value)
+                        if s is not None and s not in canon:
+                            ctx.add(
+                                f, node.lineno, "LH301",
+                                f"stage={s!r} is not canonical (see "
+                                f"{STAGES_REL})",
+                            )
+                # scoped_env({"LHTPU_FAULT_INJECT": "..."}) and friends
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Dict):
+                        for k, v in zip(arg.keys, arg.values):
+                            env = _str_const(k)
+                            if env not in ("LHTPU_FAULT_INJECT",
+                                           "LHTPU_CHAOS_SCHEDULE"):
+                                continue
+                            lit = _leading_literal(v)
+                            if lit:
+                                _check_spec(ctx, f, v.lineno, env, lit,
+                                            canon)
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    env = _spec_env_name(target)
+                    if env is not None:
+                        lit = _leading_literal(node.value)
+                        if lit:
+                            _check_spec(ctx, f, node.lineno, env, lit,
+                                        canon)
+                    # module-level FOO_STAGES = ("pack", ...)
+                    elif (isinstance(target, ast.Name)
+                          and target.id.endswith("STAGES")
+                          and isinstance(node.value,
+                                         (ast.Tuple, ast.List))):
+                        for el in node.value.elts:
+                            s = _str_const(el)
+                            if s is not None and s not in canon:
+                                ctx.add(
+                                    f, el.lineno, "LH303",
+                                    f"{target.id} contains "
+                                    f"non-canonical stage {s!r} (see "
+                                    f"{STAGES_REL})",
+                                )

@@ -29,13 +29,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from lighthouse_tpu.common import knobs  # noqa: E402
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--epochs", type=int, default=8)
-    ap.add_argument("--chaos", default=os.environ.get(
-        "LHTPU_CHAOS_SCHEDULE", ""),
-        help="epoch:stage:kind:count[;...] chaos schedule")
+    ap.add_argument("--chaos", default=knobs.knob("LHTPU_CHAOS_SCHEDULE"),
+                    help="epoch:stage:kind:count[;...] chaos schedule")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--slots", type=int, default=2,
                     help="slots per epoch stream")
