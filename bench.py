@@ -118,6 +118,30 @@ def _resilience_detail() -> dict:
     }
 
 
+def _dedup_detail() -> dict:
+    """Cumulative message-dedup traffic (distinct vs collapsed rows seen
+    by blsrt.dedup_plan) and the deduped-batch cache counters, so a
+    message_dup_sweep line shows how much hash work the dedup front-end
+    actually removed (ISSUE 10 tentpole c)."""
+    blsrt = sys.modules.get("lighthouse_tpu.blsrt")
+    if blsrt is None:
+        return {}
+    try:
+        report = blsrt.input_cache_report()
+        return {
+            "messages_distinct": blsrt.DEDUP_MESSAGES.value(
+                outcome="distinct"
+            ),
+            "messages_collapsed": blsrt.DEDUP_MESSAGES.value(
+                outcome="duplicate"
+            ),
+            "batch_cache": report.get("htc_batches") or {},
+        }
+    except Exception as exc:
+        _note_swallowed("dedup_detail", exc)
+        return {}
+
+
 def _pipeline_detail() -> dict:
     """{"pipeline": {...}} for EVERY emitted JSON line: whether the last
     verify took the pipelined microbatch path, its chunk count and
@@ -845,9 +869,11 @@ def message_dup_sweep(backend, S: int, reps: int,
     """``--message-dup``: e2e rate on batches where many sets share one
     message — the gossip-attestation reality (a committee's unaggregated
     attestations all sign the SAME data). One ``bls_message_dup_sweep``
-    JSON line per duplication factor; today every duplicate pays a full
-    hash-to-curve + verify lane, so these lines are the measured
-    baseline the future hash-to-curve dedup win must beat."""
+    JSON line per duplication factor. Since ISSUE 10 the backend dedups
+    these batches before hash_to_curve, so each line also carries the
+    htc_dedup/htc_map/htc_cofactor sub-stage split (detail.stages) and
+    the dedup traffic counters that prove how many hashes the gather
+    plan saved."""
     from lighthouse_tpu.crypto.bls.api import (
         AggregateSignature,
         SignatureSet,
@@ -884,6 +910,8 @@ def message_dup_sweep(backend, S: int, reps: int,
                     "distinct_messages": distinct,
                     "e2e_sync_ms_per_batch": round(dt * 1e3, 2),
                     "path": backend.last_path,
+                    "stages": _stage_report(),
+                    "dedup": _dedup_detail(),
                     **_pipeline_detail(),
                     **_resilience_detail(),
                     **_parallel_detail(),

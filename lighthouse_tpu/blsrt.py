@@ -209,6 +209,73 @@ class PubkeyRowCache:
 
 PUBKEY_ROW_CACHE = PubkeyRowCache("pubkey_rows", "LHTPU_PUBKEY_CACHE")
 HTC_CACHE = InputCache("hash_to_curve", "LHTPU_HTC_CACHE")
+# Device-resident outputs of whole DISTINCT-message batches, keyed by the
+# distinct tuple: an epoch's steady state re-verifies the same slot
+# payloads, and after dedup those collapse to identical distinct tuples,
+# so a warm dispatch skips the curve map entirely (ISSUE 10 tentpole c).
+HTC_BATCH_CACHE = InputCache("htc_batches", "LHTPU_HTC_BATCH_CACHE")
+
+DEDUP_MESSAGES = REGISTRY.counter(
+    "bls_htc_dedup_messages_total",
+    "Messages entering hash_to_curve, by dedup outcome",
+    ("outcome",),
+)
+
+
+class DedupPlan:
+    """Gather plan for protocol-aware message dedup (ISSUE 10).
+
+    Mainnet attestation batches repeat each committee message ~64 times
+    (SURVEY §2: committees per slot share one AttestationData). The plan
+    maps a message batch to its distinct prefix plus an int32 gather
+    index, so hash_to_curve runs once per DISTINCT message and the
+    verifier's [S]-row grid is rebuilt with one fancy-index gather.
+    Row i of the output equals the hash of ``distinct[index[i]]`` —
+    bit-identical to hashing row i directly, because hash_to_curve is a
+    pure function of the message bytes."""
+
+    __slots__ = ("distinct", "index", "enabled")
+
+    def __init__(self, distinct, index, enabled: bool):
+        self.distinct = distinct          # list[bytes], first-seen order
+        self.index = index                # np.int32[n] rows -> distinct
+        self.enabled = enabled            # False for the identity plan
+
+    @property
+    def n(self) -> int:
+        return len(self.index)
+
+
+def identity_plan(messages) -> "DedupPlan":
+    """Degradation target: every row is its own 'distinct' entry, so the
+    downstream gather is the identity permutation and the batch behaves
+    exactly as it did before dedup existed."""
+    msgs = [bytes(m) for m in messages]
+    return DedupPlan(msgs, np.arange(len(msgs), dtype=np.int32), False)
+
+
+def dedup_plan(messages) -> "DedupPlan":
+    """Build the dedup plan for one batch, honoring LHTPU_HTC_DEDUP=0
+    (identity plan). Counts distinct/duplicate traffic so bench and the
+    stage report can show the protocol-shape win."""
+    if not knobs.knob("LHTPU_HTC_DEDUP"):
+        return identity_plan(messages)
+    distinct: list[bytes] = []
+    first: dict[bytes, int] = {}
+    index = np.empty(len(messages), np.int32)
+    for i, m in enumerate(messages):
+        key = bytes(m)
+        j = first.get(key)
+        if j is None:
+            j = first[key] = len(distinct)
+            distinct.append(key)
+        index[i] = j
+    dups = len(index) - len(distinct)
+    if distinct:
+        DEDUP_MESSAGES.inc(len(distinct), outcome="distinct")
+    if dups:
+        DEDUP_MESSAGES.inc(dups, outcome="duplicate")
+    return DedupPlan(distinct, index, True)
 
 
 def pubkey_cache_key(pk):
@@ -223,6 +290,7 @@ def pubkey_cache_key(pk):
 def reset_input_caches() -> None:
     PUBKEY_ROW_CACHE.clear()
     HTC_CACHE.clear()
+    HTC_BATCH_CACHE.clear()
 
 
 def input_cache_report() -> dict:
@@ -236,6 +304,7 @@ def input_cache_report() -> dict:
     for name, cache in (
         ("pubkey_rows", PUBKEY_ROW_CACHE),
         ("hash_to_curve", HTC_CACHE),
+        ("htc_batches", HTC_BATCH_CACHE),
     ):
         entry = counts.setdefault(
             name, {"hit": 0.0, "miss": 0.0, "evict": 0.0}

@@ -182,6 +182,12 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_HTC_CACHE", "int", 4096,
          "Hash-to-curve output cache capacity (distinct messages)",
          "lighthouse_tpu/blsrt.py"),
+    Knob("LHTPU_HTC_DEDUP", "bool", True,
+         "0 disables protocol-aware message dedup before hash-to-curve (identity plan)",
+         "lighthouse_tpu/blsrt.py"),
+    Knob("LHTPU_HTC_BATCH_CACHE", "int", 8,
+         "Device-resident distinct-message-batch output cache entries (0 disables)",
+         "lighthouse_tpu/blsrt.py"),
     # -------------------------------------------------- ops kernels
     Knob("LHTPU_KS_CARRY", "bool", False,
          "Enable the Kogge-Stone carry-select normalization (TPU-lowering gated; see tkernel)",
@@ -192,6 +198,12 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_MXU_FOLD", "optstr", None,
          "Force the MXU Montgomery fold on (1) / off (0); unset = on when the backend is TPU",
          "lighthouse_tpu/ops/tkernel.py"),
+    Knob("LHTPU_HTC_MXU_LADDER", "optstr", None,
+         "Force Fp2 muln stacking in the ladder kernels on (1) / off (0); unset = follow the MXU fold",
+         "lighthouse_tpu/ops/tkernel.py"),
+    Knob("LHTPU_HTC_RESIDENT", "optstr", None,
+         "Force the single resident hash-to-G2 map kernel on (1) / off (0); unset = on",
+         "lighthouse_tpu/ops/tkernel_htc.py"),
     Knob("LHTPU_VMEM_LIMIT_MB", "int", 64,
          "Pallas compiler VMEM limit per kernel in MiB",
          "lighthouse_tpu/ops/tkernel.py"),

@@ -137,8 +137,15 @@ _PERMANENT_PATTERNS: tuple[tuple[str, str], ...] = (
     ("chip removed from mesh", "chip_loss"),
 )
 _TRANSIENT_PATTERNS: tuple[tuple[str, str], ...] = (
+    # The r05 bench-killer family: the PJRT proxy's HTTP body truncated
+    # mid-read ("remote_compile: read body: response body closed before
+    # all bytes were read", BENCH_r05.json). Seeded broadly — any
+    # "read body" / "closed before all bytes" truncation is the same
+    # droppable-response shape, whichever endpoint the proxy names.
     ("remote_compile", "remote_compile"),        # r05
     ("response body closed", "remote_compile"),  # r05
+    ("read body", "remote_compile"),             # r05 family
+    ("closed before all bytes", "remote_compile"),  # r05 family
     ("unable to initialize backend", "backend_init"),  # r03
     ("backend setup/compile error", "backend_init"),   # r03
     ("connection reset", "socket"),

@@ -18,6 +18,12 @@ CANONICAL_STAGES: tuple[str, ...] = (
     # Host-side assembly, in hot-path order.
     "pack",            # ints -> Montgomery limb grids
     "hash_to_curve",   # messages -> G2 points (host or device HTC)
+    # hash_to_curve sub-stages (ISSUE 10): nested inside the outer
+    # wrapper so the aggregate stays comparable across rounds while the
+    # split shows where hashing time goes.
+    "htc_dedup",       # protocol-aware distinct-message gather plan
+    "htc_map",         # sswu+iso curve map (resident program on TPU)
+    "htc_cofactor",    # cofactor clear + canonical affine / assembly
     "scalars",         # RLC scalar sampling + bit decomposition
     "msm_schedule",    # MSM bucket schedule build (fused path)
     # Device phases.

@@ -1091,35 +1091,85 @@ class JaxBackend:
     def _hash_messages(self, sets, S: int, inf2):
         return self._hash_message_bytes([s.message for s in sets], S, inf2)
 
-    def _hash_message_bytes(self, messages, S: int, inf2):
+    @staticmethod
+    def _batch_cache_on(blsrt) -> bool:
+        """Whole-distinct-batch output caching wants BOTH the cache
+        family switch and a nonzero capacity (capacity floors at 1 for
+        the LRU itself, so a 0 must be gated here)."""
+        cap = int(knobs.knob("LHTPU_HTC_BATCH_CACHE"))
+        return blsrt.input_caches_enabled() and cap > 0
+
+    def _hash_message_bytes(self, messages, S: int, inf2, stages=None):
         """(mx, my, minf) for the S padded slots from raw message bytes.
 
-        Each *distinct* message is hashed once (a slot's attestations share
-        few). On TPU the SSWU pipeline runs batched on device
-        (ops/htc.hash_to_g2_batch) — round 1 left this as the 8.6 ms/msg
-        pure-Python bottleneck; off-TPU the oracle path stays (the classic
-        XLA pipeline would recompile per CPU test shape).
+        Three sub-stages, each individually retried/injectable and each
+        visible in dispatch_stage_report (ISSUE 10):
+
+        * htc_dedup — protocol-aware gather plan (blsrt.dedup_plan): a
+          mainnet slot repeats each committee message ~64×, so hashing
+          runs once per DISTINCT message. Any failure here degrades IN
+          PLACE to the identity plan — bit-identical output, never a
+          crash — because dedup is a pure optimization.
+        * htc_map — the curve map for the distinct batch: on TPU the
+          resident sswu→iso→add(→cofactor) Pallas program; off-TPU the
+          per-message oracle memo fill (the classic XLA pipeline would
+          recompile per CPU test shape).
+        * htc_cofactor — cofactor clear + canonical affine on TPU (a
+          no-op clear when the resident program already ran the
+          ladder); off-TPU the gather/limbify assembly.
+
+        Failures in htc_map/htc_cofactor re-raise through the outer
+        hash_to_curve stage to the rung ladder, like any dispatch
+        stage. ``stages`` defaults to the live per-dispatch dict that
+        _dispatch points ``last_stage_seconds`` at.
         """
+        from . import blsrt
+
+        if stages is None:
+            stages = self.last_stage_seconds
         n = len(messages)
-        distinct: list[bytes] = []
-        index: dict[bytes, int] = {}
-        for m in messages:
-            if m not in index:
-                index[m] = len(distinct)
-                distinct.append(m)
+        try:
+            plan = _retry_stage(
+                "htc_dedup", stages, lambda: blsrt.dedup_plan(messages)
+            )
+        except Exception as exc:
+            resilience.DEGRADED_TOTAL.inc(path="htc-dedup")
+            _LOG.warn(
+                "message dedup degraded to identity plan",
+                cause=str(exc)[:200],
+            )
+            plan = blsrt.identity_plan(messages)
 
         if self._use_device_htc():
-            from .ops.tkernel_htc import hash_to_g2_fused_dev
+            from .ops.tkernel_htc import (
+                hash_to_g2_finish_dev,
+                hash_to_g2_map_dev,
+            )
 
             # Pad the distinct-message batch to a power of two so XLA
             # compiles per bucket, not per count. Everything below stays
             # on device (async dispatch, no numpy sync): the verify
             # program chains directly onto the hash outputs.
-            D = _next_pow2(len(distinct))
-            padded = distinct + [distinct[0]] * (D - len(distinct))
-            hx, hy, hinf = hash_to_g2_fused_dev(padded)
+            D = _next_pow2(len(plan.distinct))
+            padded = plan.distinct + [plan.distinct[0]] * (
+                D - len(plan.distinct)
+            )
+            cache_on = self._batch_cache_on(blsrt)
+            key = tuple(padded)
+            out = blsrt.HTC_BATCH_CACHE.get(key) if cache_on else None
+            if out is None:
+                Qc = _retry_stage(
+                    "htc_map", stages, lambda: hash_to_g2_map_dev(padded)
+                )
+                out = _retry_stage(
+                    "htc_cofactor", stages,
+                    lambda: hash_to_g2_finish_dev(*Qc),
+                )
+                if cache_on:
+                    blsrt.HTC_BATCH_CACHE.put(key, out)
+            hx, hy, hinf = out
             idx = np.zeros((S,), np.int32)
-            idx[:n] = [index[m] for m in messages]
+            idx[:n] = plan.index
             pad_inf = np.ones((S,), bool)
             pad_inf[:n] = False
             idx_d = jnp.asarray(idx)
@@ -1133,20 +1183,25 @@ class JaxBackend:
         # memo is the bounded cross-call LRU in blsrt (ISSUE 4 satellite;
         # the device-HTC path above keeps per-call dedup only: its
         # outputs live on device and chain into the verify program).
-        from . import blsrt
+        def fill_memo():
+            if blsrt.input_caches_enabled():
+                memo = []
+                for m in plan.distinct:
+                    pt = blsrt.HTC_CACHE.get(m)
+                    if pt is None:
+                        pt = hash_to_g2(m)
+                        blsrt.HTC_CACHE.put(m, pt)
+                    memo.append(pt)
+                return memo
+            return [hash_to_g2(m) for m in plan.distinct]
 
-        if blsrt.input_caches_enabled():
-            memo = []
-            for m in distinct:
-                pt = blsrt.HTC_CACHE.get(m)
-                if pt is None:
-                    pt = hash_to_g2(m)
-                    blsrt.HTC_CACHE.put(m, pt)
-                memo.append(pt)
-        else:
-            memo = [hash_to_g2(m) for m in distinct]
-        msgs = [memo[index[m]] for m in messages] + [inf2] * (S - n)
-        return g2_to_dev(msgs)
+        memo = _retry_stage("htc_map", stages, fill_memo)
+
+        def assemble():
+            msgs = [memo[j] for j in plan.index] + [inf2] * (S - n)
+            return g2_to_dev(msgs)
+
+        return _retry_stage("htc_cofactor", stages, assemble)
 
     def verify_signature_sets(self, sets) -> bool:
         """Resilient entry point: transient faults inside any dispatch

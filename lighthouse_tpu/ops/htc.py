@@ -315,9 +315,20 @@ def hash_to_field_dev(msgs, dst: bytes = DST) -> np.ndarray:
     expand_message_xmd runs at C speed (hashlib); the 64-byte-to-field
     reduction uses Python bignums (sub-µs each). This is the only
     per-message host work left in the hashing path.
+
+    Repeated messages inside one batch are hashed once and their rows
+    copied — pow-of-2 padding replicates a batch's first message, and
+    mainnet batches repeat committee messages, so the memo is routinely
+    hit. hash_to_field is a pure function of (msg, dst), so the copy is
+    bit-identical to recomputation.
     """
     out = np.empty((len(msgs), 2, 2, 48), np.int32)
+    first_row: dict[bytes, int] = {}
     for i, msg in enumerate(msgs):
+        j0 = first_row.setdefault(bytes(msg), i)
+        if j0 != i:
+            out[i] = out[j0]
+            continue
         uniform = expand_message_xmd(msg, dst, 4 * H2F_L)
         for j in range(2):
             for k in range(2):

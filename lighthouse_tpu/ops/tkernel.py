@@ -496,6 +496,24 @@ def _mxu_fold_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def ladder_stack_enabled() -> bool:
+    """Fp2-width muln stacking for the ladder kernels (cofactor clear,
+    psi subgroup check, resident hash-to-G2 map).
+
+    Pre-fold the conv engine measured SLOWER on wide Fp2 stacks
+    (scalar_mul_g2 406→548 ms — FieldOps.muln note), so Fp2 namespaces
+    default to looping. The MXU fold changes the trade: its byte regroup
+    and carry-estimate passes are vectorized over the stacked leading
+    axis, so one muln over k products amortizes the VPU-bound portion k
+    ways while the per-row dots stay the same. LHTPU_HTC_MXU_LADDER=0/1
+    forces; default follows the fold. Read at trace time, like
+    LHTPU_MXU_FOLD."""
+    choice = _knobs.knob("LHTPU_HTC_MXU_LADDER")
+    if choice is not None:
+        return choice == "1"
+    return _mxu_fold_enabled()
+
+
 def vmem_params():
     """Mosaic compiler params raising the scoped-VMEM budget.
 
@@ -1027,7 +1045,11 @@ def fp_ops_t() -> TFieldOps:
     )
 
 
-def fp2_ops_t() -> TFieldOps:
+def fp2_ops_t(stack_muln: bool = False) -> TFieldOps:
+    """Fp2 FieldOps; ``stack_muln`` default False (Fp2 mont rows are
+    bandwidth-bound on the conv engine — see FieldOps.muln). The ladder
+    kernels opt in via ladder_stack_enabled() where the MXU fold
+    amortizes stacked rows."""
     zero2 = jnp.zeros((2, N_LIMBS, 1), jnp.int32)
     one2 = jnp.concatenate(
         [_c("R")[None], jnp.zeros((1, N_LIMBS, 1), jnp.int32)]
@@ -1038,5 +1060,5 @@ def fp2_ops_t() -> TFieldOps:
         is_zero=fp2_is_zero_t, eq=fp2_eq_t,
         zero=zero2, one=one2, ndim_tail=3,
         canon=canonical_t,
-        stack_muln=False,  # Fp2 mont rows are bandwidth-bound (see muln)
+        stack_muln=stack_muln,
     )

@@ -198,7 +198,9 @@ def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, mont_ref, out_ref):
     infinity passes (pt_subgroup_check semantics). lowmem: the grouped
     -conv windows put the 256-lane body 78K over the VMEM limit."""
     with tk.bound_consts(consts_ref[:], mont=mont_ref[:], lowmem=True):
-        F = tk.fp2_ops_t()
+        # stacked muln in the ladder when the MXU fold amortizes it
+        # (tk.ladder_stack_enabled) — the walk is this kernel's cost.
+        F = tk.fp2_ops_t(stack_muln=tk.ladder_stack_enabled())
         x, y = x_ref[:], y_ref[:]
         inf = inf_ref[0, :] != 0
 
@@ -213,14 +215,17 @@ def _subgroup_fast_kernel(x_ref, y_ref, inf_ref, consts_ref, mont_ref, out_ref):
         Xj, Yj, Zj = acc[0], F.neg(acc[1]), acc[2]
 
         # psi(Q) = (conj(x)*CX, conj(y)*CY), affine
-        px = tk.fp2_mul_t(tk.fp2_conj_t(x), tk._c2("PSI_CX"))
-        py = tk.fp2_mul_t(tk.fp2_conj_t(y), tk._c2("PSI_CY"))
+        px, py = F.muln(
+            (tk.fp2_conj_t(x), tk._c2("PSI_CX")),
+            (tk.fp2_conj_t(y), tk._c2("PSI_CY")),
+        )
 
         # affine-vs-Jacobian equality without inversion:
         # px == Xj/Zj^2, py == Yj/Zj^3
         z2 = F.sqr(Zj)
         z3 = F.mul(z2, Zj)
-        eq = tk.fp2_eq_t(F.mul(px, z2), Xj) & tk.fp2_eq_t(F.mul(py, z3), Yj)
+        lhsx, lhsy = F.muln((px, z2), (py, z3))
+        eq = tk.fp2_eq_t(lhsx, Xj) & tk.fp2_eq_t(lhsy, Yj)
         # [x]Q infinite while Q isn't -> not in G2 (psi(Q) finite)
         eq = eq & ~F.is_zero(Zj)
         out_ref[0, :] = (eq | inf).astype(jnp.int32)

@@ -111,3 +111,92 @@ def test_hash_to_field_dev_matches_oracle():
         u0, u1 = hash_to_field_fq2(m, 2)
         assert Fq2(*tower.fp2_from_dev(u[i, 0])) == u0
         assert Fq2(*tower.fp2_from_dev(u[i, 1])) == u1
+
+
+def test_hash_to_field_dev_intra_batch_memo():
+    """Duplicate rows (incl. the pow-2 padding replicas) are copied from
+    the first occurrence — bit-identical to hashing each row."""
+    msgs = [b"dup", b"other", b"dup", b"dup"]
+    u = htc.hash_to_field_dev(msgs)
+    np.testing.assert_array_equal(u[0], u[2])
+    np.testing.assert_array_equal(u[0], u[3])
+    solo = htc.hash_to_field_dev([b"dup"])
+    np.testing.assert_array_equal(u[0], solo[0])
+
+
+def test_hash_to_g2_fused_resident_matches_chained(monkeypatch):
+    """ISSUE 10 tentpole (b): the single resident sswu→iso→add→cofactor
+    program (LHTPU_HTC_RESIDENT=1, default) vs the two-kernel chained
+    A/B path (=0) — bit-identical at the canonical affine boundary."""
+    from lighthouse_tpu.ops.tkernel_htc import hash_to_g2_fused
+
+    msgs = [b"", b"abc", bytes(range(32)), b"fused-vs-classic"]
+    monkeypatch.setenv("LHTPU_HTC_RESIDENT", "1")
+    rx, ry, rinf = hash_to_g2_fused(msgs)
+    monkeypatch.setenv("LHTPU_HTC_RESIDENT", "0")
+    cx, cy, cinf = hash_to_g2_fused(msgs)
+    np.testing.assert_array_equal(rx, cx)
+    np.testing.assert_array_equal(ry, cy)
+    np.testing.assert_array_equal(rinf, cinf)
+
+
+def test_hash_to_g2_fused_rfc_j10_1():
+    """External known-answer gate for the resident program: the RFC 9380
+    J.10.1 vectors through hash_to_g2_fused (same anchors the classic
+    device pipeline and the oracle pass)."""
+    from lighthouse_tpu.ops.tkernel_htc import hash_to_g2_fused
+    from tests.test_hash_to_curve import RFC_H2C_DST, RFC_J10_1
+
+    msgs = list(RFC_J10_1)
+    x, y, inf = hash_to_g2_fused(msgs, RFC_H2C_DST)
+    for i, m in enumerate(msgs):
+        ex, ey = RFC_J10_1[m]
+        assert not bool(inf[i])
+        assert _from_dev(x, i) == Fq2(*ex)
+        assert _from_dev(y, i) == Fq2(*ey)
+
+
+def test_map_finish_split_matches_fused():
+    """The stage-split halves (hash_to_g2_map_dev + hash_to_g2_finish_dev)
+    compose to exactly hash_to_g2_fused_dev."""
+    from lighthouse_tpu.ops import tkernel_htc as th
+
+    msgs = [b"", b"abc", bytes(range(32)), b"fused-vs-classic"]
+    Q, cleared = th.hash_to_g2_map_dev(msgs)
+    sx, sy, sinf = (
+        np.asarray(v) for v in th.hash_to_g2_finish_dev(Q, cleared)
+    )
+    fx, fy, finf = th.hash_to_g2_fused(msgs)
+    np.testing.assert_array_equal(sx, fx)
+    np.testing.assert_array_equal(sy, fy)
+    np.testing.assert_array_equal(sinf, finf)
+
+
+def test_device_dedup_gather_matches_oracle(monkeypatch):
+    """Device-HTC dedup gather (ISSUE 10 tentpole c): every padded row
+    of _hash_message_bytes is bit-exact vs the per-row oracle, at the
+    un-deduped (1), intermediate (8), and committee-shaped (64)
+    duplication factors."""
+    monkeypatch.setenv("LHTPU_DEVICE_HTC", "1")
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.crypto.bls.curve import g2_infinity
+    from lighthouse_tpu.jax_backend import JaxBackend
+
+    be = JaxBackend()
+    inf2 = g2_infinity()
+    for dup in (1, 8, 64):
+        n = 64
+        msgs = [bytes([7 + i // dup]) * 32 for i in range(n)]
+        blsrt.reset_input_caches()
+        mx, my, minf = (
+            np.asarray(v) for v in be._hash_message_bytes(msgs, n, inf2)
+        )
+        assert not minf.any()
+        for i in range(0, n, 16):  # oracle spot-rows
+            want = hash_to_g2(msgs[i])
+            assert _from_dev(mx, i) == want.x, f"dup={dup} row {i}"
+            assert _from_dev(my, i) == want.y, f"dup={dup} row {i}"
+        for i in range(n):  # duplicates byte-equal their first occurrence
+            j = (i // dup) * dup
+            np.testing.assert_array_equal(mx[i], mx[j])
+            np.testing.assert_array_equal(my[i], my[j])

@@ -49,6 +49,30 @@ def test_device_rejects_wrong_key():
     assert not get_backend("jax").verify_signature_sets(sets)
 
 
+def test_poisoned_duplicate_message_not_aliased():
+    """ISSUE 10 dedup: both sets carry the SAME message, one signature
+    is tampered. The dedup gather may alias the HASH rows, but never the
+    verdicts — the tampered set must still fail, and the honest twin
+    batch must still pass. Same (S=2, K=2) bucket as _valid_sets."""
+    be = get_backend("jax")
+    s0 = SignatureSet.single_pubkey(SKS[0].sign(M0), PKS[0], M0)
+    bad_agg = AggregateSignature.aggregate(
+        [SKS[1].sign(M1), SKS[2].sign(M1)]  # signed M1 ...
+    )
+    s1_bad = SignatureSet.multiple_pubkeys(
+        bad_agg, [PKS[1], PKS[2]], M0  # ... but claims M0
+    )
+    assert not verify_signature_sets_python([s0, s1_bad])
+    assert not be.verify_signature_sets([s0, s1_bad])
+
+    ok_agg = AggregateSignature.aggregate(
+        [SKS[1].sign(M0), SKS[2].sign(M0)]
+    )
+    s1_ok = SignatureSet.multiple_pubkeys(ok_agg, [PKS[1], PKS[2]], M0)
+    assert verify_signature_sets_python([s0, s1_ok])
+    assert be.verify_signature_sets([s0, s1_ok])
+
+
 def test_structural_rejections_host_side():
     be = get_backend("jax")
     assert not be.verify_signature_sets([])

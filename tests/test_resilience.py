@@ -97,6 +97,30 @@ class TestClassifier:
         got = resilience.classify(AssertionError("timeout in verdict"))
         assert got == (resilience.PERMANENT, "AssertionError")
 
+    @pytest.mark.parametrize("msg", [
+        # the exact BENCH_r05.json literal
+        R05_REMOTE_COMPILE,
+        # family variants: same truncated-HTTP-read shape, different
+        # endpoint / phrasing — each must land transient on its own
+        # seed, not only via the "remote_compile" substring
+        "read body: response body closed before all bytes were read",
+        "INTERNAL: http://127.0.0.1:8103/fetch_result: read body: "
+        "connection closed mid-stream",
+        "stream closed before all bytes were read",
+    ])
+    def test_r05_read_body_family_is_transient(self, msg):
+        category, kind = resilience.classify(RuntimeError(msg))
+        assert category == resilience.TRANSIENT
+        assert kind == "remote_compile"
+
+    def test_read_body_never_outranks_permanent(self):
+        # the permanent table wins even when the message carries the
+        # r05 truncation phrasing
+        got = resilience.classify(
+            RuntimeError("Mosaic lowering failed while read body")
+        )
+        assert got == (resilience.PERMANENT, "lowering")
+
 
 class TestRetryPolicy:
     def test_backoff_doubles_and_caps(self):

@@ -199,6 +199,33 @@ def test_triage_zero_repack_on_refinement(triage_env):
     assert tr["clean_groups"] + tr["poisoned_groups"] >= 2
 
 
+def test_triage_poisoned_duplicate_message(triage_env):
+    """ISSUE 10 dedup: all four sets share ONE message and set 2's
+    signature is tampered (signed over M_BAD). Dedup collapses the
+    hash_to_curve batch to a single distinct row; the per-set verdicts
+    must not alias — the tampered set alone fails. Same (S=4, K=2)
+    bucket family as the other triage cases."""
+    m = b"\x5a" * 32
+    sets = []
+    for i in range(4):
+        signed = M_BAD if i == 2 else m
+        if i % 2 == 0:
+            sk = SKS[i]
+            sets.append(SignatureSet.single_pubkey(
+                sk.sign(signed), sk.public_key(), m
+            ))
+        else:
+            a, b = SKS[i], SKS[i + 3]
+            agg = AggregateSignature.aggregate([a.sign(signed), b.sign(m)])
+            sets.append(SignatureSet.multiple_pubkeys(
+                agg, [a.public_key(), b.public_key()], m
+            ))
+    be = jb.JaxBackend()
+    got = be.verify_signature_sets_triaged(sets)
+    assert got == [True, True, False, True]
+    assert got == _oracle(sets)
+
+
 def test_triage_pipelined_matches(triage_env, monkeypatch):
     """Chunked triage (2 chunks of 2, gs=1 per chunk) agrees with the
     oracle and stamps the pipeline suffix on the path."""

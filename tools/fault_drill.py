@@ -41,9 +41,11 @@ STAGES = tuple(
 QUICK_STAGES = ("hash_to_curve", "dispatch", "device_sync")
 #: stages the grouped-triage path actually enters (it never builds an
 #: MSM schedule — per-group accumulators are incompatible with the
-#: single global MSM fold).
-TRIAGE_STAGES = ("pack", "hash_to_curve", "scalars", "dispatch",
-                 "device_sync")
+#: single global MSM fold). Includes the ISSUE 10 hash sub-stages: a
+#: dedup fault must degrade in place to the identity plan (bit-identical
+#: verdicts), map/cofactor faults ride the normal ladder.
+TRIAGE_STAGES = ("pack", "hash_to_curve", "htc_dedup", "htc_map",
+                 "htc_cofactor", "scalars", "dispatch", "device_sync")
 
 #: kind -> (classifier category, human label)
 KINDS = (

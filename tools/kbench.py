@@ -1,13 +1,21 @@
 """Micro-benchmark individual fused kernels on the current device.
 
-Usage: python tools/kbench.py [S] [name ...]
+Usage: python tools/kbench.py [--fresh] [S] [name ...]
 
 Names: scalar_g1 scalar_g2 subgroup subgroup_full to_affine_g1
-       to_affine_g2 miller sswu cofactor final_exp
+       to_affine_g2 miller sswu sswu_iso cofactor psi_subgroup
+       map_resident final_exp
 
 Each kernel is compiled (persistent cache), warmed, then timed over
 REPS=5 with block_until_ready. Inputs are generator-point lanes — timing
-is data-independent (constant-time chains)."""
+is data-independent (constant-time chains).
+
+``--fresh`` runs each requested row in its OWN subprocess: one cold
+python → jax → kernel lifecycle per row, so a number can never ride a
+stale device sync or a warm tunnel left by an earlier kernel (the
+stale-sync hazard documented in README). Default rows under --fresh are
+the ISSUE 10 hash-side trio (sswu_iso, cofactor, psi_subgroup) whose
+MXU-ladder/resident wins must be confirmed per-kernel from cold."""
 
 from __future__ import annotations
 
@@ -49,9 +57,50 @@ def timeit(label, fn):
     sys.stdout.flush()
 
 
+#: default rows for --fresh: the hash-side kernels whose ISSUE 10 wins
+#: are claimed per-kernel (cold process each, no shared device state).
+FRESH_NAMES = ("sswu_iso", "cofactor", "psi_subgroup")
+
+
+def run_fresh(S: int, names) -> int:
+    """One subprocess per row: python -> jax init -> single kernel.
+
+    The child is this same script with one name; its stdout rows are
+    re-emitted under a ``fresh`` prefix so a sweep reads as one table.
+    Returns the count of failed children (nonzero exit / no row)."""
+    import subprocess
+
+    failed = 0
+    for name in names:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), str(S), name],
+            capture_output=True, text=True,
+        )
+        rows = [
+            ln for ln in proc.stdout.splitlines()
+            if ln and not ln.startswith("device=")
+        ]
+        if proc.returncode != 0 or not rows:
+            failed += 1
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            print(f"fresh {name:22s} FAILED rc={proc.returncode} "
+                  f"{' | '.join(tail)}")
+        else:
+            for ln in rows:
+                print(f"fresh {ln}")
+        sys.stdout.flush()
+    return failed
+
+
 def main():
-    S = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-    names = sys.argv[2:] or [
+    argv = [a for a in sys.argv[1:] if a != "--fresh"]
+    fresh = len(argv) != len(sys.argv) - 1
+    S = int(argv[0]) if argv else 2048
+    if fresh:
+        names = argv[1:] or list(FRESH_NAMES)
+        print(f"device=fresh-subprocess S={S} reps={REPS}")
+        sys.exit(1 if run_fresh(S, names) else 0)
+    names = argv[1:] or [
         "scalar_g1", "scalar_g2", "subgroup", "to_affine_g1",
         "to_affine_g2", "miller", "sswu", "cofactor", "final_exp",
     ]
@@ -86,13 +135,28 @@ def main():
         elif name == "miller":
             timeit("miller_loop", lambda: tc.miller_loop_kernel_t(
                 (g1x, g1y), inf_row[0] != 0, (g2x, g2y), inf_row[0] != 0))
-        elif name == "sswu":
+        elif name in ("sswu", "sswu_iso"):
             from lighthouse_tpu.ops.tkernel_htc import _interpret, _sswu_iso_t
             u = g2x  # any Fp2 lanes work as field input
             timeit("sswu+iso", lambda: _sswu_iso_t(u, _interpret()))
         elif name == "cofactor":
             from lighthouse_tpu.ops.tkernel_htc import _cofactor_t, _interpret
             timeit("cofactor", lambda: _cofactor_t(jac2, _interpret()))
+        elif name == "psi_subgroup":
+            # same kernel as "subgroup"; named row so the ISSUE 10
+            # ladder-stacking win reads per-kernel in fresh sweeps
+            timeit("psi_subgroup", lambda: tc.subgroup_check_g2_fast_t(
+                g2x, g2y, inf_row))
+        elif name == "map_resident":
+            from lighthouse_tpu.ops.tkernel_htc import (
+                _interpret,
+                _map_to_g2_resident_t,
+            )
+            us = jnp.broadcast_to(
+                jnp.asarray(G2_GEN_DEV[0])[None, ..., None], (2, 2, 48, S)
+            )
+            timeit("map_resident (sswu..cof)", lambda:
+                   _map_to_g2_resident_t(us, _interpret()))
         elif name == "final_exp":
             f = jnp.broadcast_to(
                 jnp.zeros((2, 3, 2, 48, 1), jnp.int32).at[0, 0, 0].set(tk._c("R")),

@@ -212,7 +212,8 @@ def main():
     # ------------------------------------------------ hash path stages
     from lighthouse_tpu.ops.htc import DST, hash_to_field_dev
     from lighthouse_tpu.ops.tkernel_htc import (
-        _cofactor_t, _interpret, _map_to_g2_fused, _sswu_iso_t,
+        _cofactor_t, _interpret, _map_to_g2_fused, _map_to_g2_resident_t,
+        _sswu_iso_t,
     )
 
     t0 = time.perf_counter()
@@ -220,6 +221,7 @@ def main():
     u = jax.block_until_ready(u)
     record('host hash_to_field (SHA)', (time.perf_counter()-t0)*1e3)
 
+    # chained A/B path (LHTPU_HTC_RESIDENT=0): per-kernel attribution
     n = u.shape[0]
     flat = jnp.moveaxis(u, 1, 0).reshape(2 * n, 2, 48)
     ut = jax.block_until_ready(tk.batch_to_t(flat))
@@ -233,7 +235,39 @@ def main():
     timeit("cofactor kernel (S lanes)", lambda: _cofactor_t(Q, _interpret()))
     Qc = jax.block_until_ready(_cofactor_t(Q, _interpret()))
     timeit("to_affine_g2 (hash out)", lambda: tc.to_affine_g2_t(Qc))
+    # resident program (ISSUE 10 tentpole b): same math, one pallas_call
+    us = jax.block_until_ready(jnp.moveaxis(u, 0, -1))
+    timeit("map_resident (sswu..cof fused)",
+           lambda: _map_to_g2_resident_t(us, _interpret()))
     timeit("hash full _map_to_g2_fused", lambda: _map_to_g2_fused(u))
+
+    # ------------------------------------------- dedup sub-stage profile
+    # The backend's htc_dedup/htc_map/htc_cofactor split (detail.stages)
+    # under protocol-shaped duplication: S rows collapsing to S/dup
+    # distinct messages. dup=1 is the worst case (no sharing); dup=64 is
+    # the mainnet committee shape (ISSUE 10 tentpole c).
+    from lighthouse_tpu import blsrt
+    from lighthouse_tpu.jax_backend import JaxBackend
+    from lighthouse_tpu.crypto.bls.curve import g2_infinity
+
+    be = JaxBackend()
+    inf2 = g2_infinity()
+    for dup in (1, 64):
+        dmsgs = [
+            (i // dup).to_bytes(32, "big") for i in range(S)
+        ]
+        sub: dict[str, float] = {}
+        blsrt.reset_input_caches()
+        be._hash_message_bytes(dmsgs, S, inf2, stages=sub)  # warm/compile
+        sub.clear()
+        blsrt.reset_input_caches()
+        t0 = time.perf_counter()
+        out = be._hash_message_bytes(dmsgs, S, inf2, stages=sub)
+        jax.block_until_ready(out)
+        total = (time.perf_counter() - t0) * 1e3
+        for stage in ("htc_dedup", "htc_map", "htc_cofactor"):
+            record(f"{stage} (dup={dup})", sub.get(stage, 0.0) * 1e3)
+        record(f"hash_message_bytes e2e (dup={dup})", total)
 
     # ------------------------------------------- pipelined overlap report
     # One end-to-end verify through the pipelined microbatch engine
