@@ -258,8 +258,10 @@ def _emit_fallback(err: str) -> None:
     chain = mode == "slot-chain" or "--slot-chain" in sys.argv
     slot = chain or mode == "slot" or "--slot" in sys.argv
     load = mode == "slot-load" or "--slot-load" in sys.argv
+    stream = mode == "stream" or "--stream" in sys.argv
     multi = mode == "multichip" or "--devices" in sys.argv
     metric = ("multichip_sets_per_sec" if multi
+              else "stream_sets_per_sec" if stream
               else "slot_load_sets_per_sec" if load
               else "chain_slot_attester_verifications_per_sec" if chain
               else "full_slot_attester_verifications_per_sec" if slot
@@ -267,7 +269,7 @@ def _emit_fallback(err: str) -> None:
     line = {
         "metric": metric,
         "value": 0.0,
-        "unit": ("sets/sec" if load or multi
+        "unit": ("sets/sec" if load or multi or stream
                  else "attester-signatures/sec" if slot else "sets/sec"),
         "vs_baseline": 0.0,
         "error": err[:400],
@@ -478,6 +480,199 @@ def slot_load_mode() -> None:
                 "batch_deadline_ms": serve_cfg.batch_deadline_ms,
                 "admit_high": serve_cfg.admit_high,
                 "admit_low": serve_cfg.admit_low,
+            },
+            "device": dev,
+            "stages": _stage_report(),
+            **_resilience_detail(),
+            **_pipeline_detail(),
+            **_triage_detail(),
+            **_parallel_detail(),
+            **_lint_detail(),
+        },
+    }), flush=True)
+    global _HEADLINE_EMITTED
+    _HEADLINE_EMITTED = True
+
+
+def stream_mode() -> None:
+    """ISSUE 15 tentpole: CONTINUOUS multi-epoch mixed traffic through
+    the cross-slot StreamScheduler (loadgen/scheduler.py) at an
+    overload factor. Blocks preempt coalescing windows and are never
+    shed; aggregates/attestations/sync coalesce to class deadlines and
+    shed under the health-governed watermarks; committee compositions
+    repeating across slots hit the cross-slot aggregate-pubkey cache.
+
+    Emits one ``stream_epoch_served`` JSON line per epoch and a final
+    ``stream_sets_per_sec`` headline whose ``detail.slo.per_class``
+    carries per-class p50/p99/shed/preemption counts. Off-TPU the run
+    uses the deterministic virtual clock with a modeled per-chunk
+    dispatch cost CALIBRATED to the 1x arrival rate, so
+    ``BENCH_OVERLOAD`` (default 2.0) compresses arrivals to exactly
+    that factor over service capacity. With ``LHTPU_CHAOS_SCHEDULE``
+    set, the same run re-executes chaos-free and the two verdict
+    digests must match bit-for-bit (``detail.replay``).
+
+    Knobs: BENCH_EPOCHS / BENCH_OVERLOAD / BENCH_VALIDATORS /
+    BENCH_SLOTS / BENCH_POISON / BENCH_SEED / BENCH_SPS / BENCH_UNAGG /
+    BENCH_SYNC / BENCH_WALL=1 (force wall clock), plus the
+    LHTPU_SCHED_* scheduler family."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    from lighthouse_tpu.chain.scale import slot_shape
+    from lighthouse_tpu.common import knobs
+    from lighthouse_tpu.consensus.config import mainnet_spec
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.loadgen.scheduler import (
+        SchedulerConfig,
+        StreamRunner,
+    )
+    from lighthouse_tpu.loadgen.serve import VirtualClock, WallClock
+    from lighthouse_tpu.loadgen.traffic import TrafficConfig, TrafficGenerator
+
+    dev = jax.devices()[0].platform
+    tpu = dev == "tpu"
+    N = int(os.environ.get("BENCH_VALIDATORS", "1000000"))
+    epochs = int(os.environ.get("BENCH_EPOCHS", "3"))
+    slots = int(os.environ.get("BENCH_SLOTS", "2"))
+    overload = float(os.environ.get("BENCH_OVERLOAD", "2.0"))
+    poison = float(os.environ.get("BENCH_POISON", "0.0"))
+    seed = int(os.environ.get("BENCH_SEED", "20260805"))
+    sps = float(os.environ.get("BENCH_SPS", "12.0" if tpu else "1.0"))
+    unagg = int(os.environ.get("BENCH_UNAGG", "512" if tpu else "32"))
+    sync = int(os.environ.get("BENCH_SYNC", "128" if tpu else "16"))
+    wall = tpu or os.environ.get("BENCH_WALL") == "1"
+
+    committees, csize = slot_shape(N, mainnet_spec())
+    if not tpu:
+        # CPU fast tier: mainnet-derived structure, test-tier shapes.
+        committees, csize = min(committees, 2), min(csize, 4)
+
+    os.environ.setdefault("LHTPU_BATCH_TARGET", "256" if tpu else "4")
+    if not tpu:
+        # Small class queues so the overload factor engages the shed
+        # watermarks within a fast-tier epoch (agg 24 / att 16 / sync 8).
+        os.environ.setdefault("LHTPU_SCHED_QUEUE_CAP", "32")
+
+    traffic_cfg = TrafficConfig(
+        validators=N, slots=slots, seconds_per_slot=sps,
+        committees_per_slot=committees, committee_size=csize,
+        unaggregated_per_slot=unagg, sync_per_slot=sync,
+        poison_rate=poison, seed=seed,
+        key_pool=4096 if tpu else 32,
+        time_scale=1.0 / max(overload, 1e-6),
+    )
+
+    sched_overrides = {}
+    if not wall:
+        # Calibrate modeled per-chunk occupancy so service capacity
+        # equals the UNSCALED arrival rate: BENCH_OVERLOAD then means
+        # "arrivals outpace the device by exactly this factor".
+        events_per_epoch = slots * (
+            committees + unagg + sync + (1 if traffic_cfg.blocks else 0)
+        )
+        base_rate = events_per_epoch / max(slots * sps, 1e-9)
+        sched_cfg_probe = SchedulerConfig.from_env()
+        quantum = max(1, sched_cfg_probe.batch_target // 4)
+        if knobs.raw("LHTPU_SCHED_DISPATCH_MS") is None:
+            sched_overrides["dispatch_ms"] = round(
+                quantum / base_rate * 1e3, 3
+            )
+    sched_cfg = SchedulerConfig.from_env(**sched_overrides)
+
+    if os.environ.get("BENCH_COLD") != "1":
+        # Warm the single-pubkey buckets the stream will dispatch (the
+        # composition cache folds K-key aggregates to K=1 host-side).
+        warm_events = TrafficGenerator(traffic_cfg).generate()
+        singles = [te.payload.sig_set for te in warm_events
+                   if len(te.payload.sig_set.signing_keys) == 1]
+        for size in {min(sched_cfg.batch_target, len(singles)), 2, 1}:
+            if size > 0 and len(singles) >= size:
+                bls_api.verify_signature_sets_triaged(
+                    singles[:size], backend="jax"
+                )
+
+    def epoch_emit(row: dict) -> None:
+        print(json.dumps({
+            "metric": "stream_epoch_served",
+            "value": row["served"],
+            "unit": "events",
+            "vs_baseline": 0.0,
+            "detail": row,
+        }), flush=True)
+
+    def one_run(chaos: str | None, emit) -> tuple[dict, float]:
+        clock = WallClock() if wall else VirtualClock()
+        runner = StreamRunner(
+            traffic_cfg, epochs, sched_cfg, clock=clock, backend="jax",
+            chaos=chaos, emit=emit,
+        )
+        t0 = time.perf_counter()
+        rep = runner.run()
+        return rep, time.perf_counter() - t0
+
+    chaos_spec = knobs.knob("LHTPU_CHAOS_SCHEDULE") or ""
+    report, wall_s = one_run(None, epoch_emit)
+    replay = None
+    if chaos_spec:
+        # Chaos-parity acceptance: the chaos-free replay must produce a
+        # bit-identical verdict digest (faults may cost retries and
+        # rungs, never verdicts).
+        from lighthouse_tpu.common import resilience as _resil
+
+        _resil.reset()
+        clean, _ = one_run("", lambda row: None)
+        replay = {
+            "chaos_digest": report["stream"]["verdict_digest"],
+            "clean_digest": clean["stream"]["verdict_digest"],
+            "digests_match": (report["stream"]["verdict_digest"]
+                              == clean["stream"]["verdict_digest"]),
+        }
+
+    served = report["events_served"]
+    block = report["sched"]["block"]
+    ok = (report["verdicts"]["mismatches"] == 0 and served > 0
+          and block["shed"] == 0 and block["dropped"] == 0
+          and report["accounting"]["balanced"]
+          and (replay is None or replay["digests_match"]))
+    print(json.dumps({
+        "metric": "stream_sets_per_sec",
+        "value": round(served / wall_s, 2) if ok else 0.0,
+        "unit": "sets/sec",
+        "vs_baseline": 0.0,
+        "detail": {
+            "validators": N, "epochs": epochs, "slots": slots,
+            "committees": committees, "committee_size": csize,
+            "unaggregated_per_slot": unagg, "sync_per_slot": sync,
+            "seconds_per_slot": sps, "overload": overload,
+            "poison_rate": poison, "seed": seed,
+            "clock": "wall" if wall else "virtual",
+            "events": report["stream"]["events"],
+            "events_served": served,
+            "verified": bool(ok),
+            "mismatches": report["verdicts"]["mismatches"],
+            "invalid_verdicts": report["verdicts"]["invalid"],
+            "verdict_digest": report["stream"]["verdict_digest"],
+            "slo": report["slo"],
+            "sched": report["sched"],
+            "shed_by_class": report["shed_by_class"],
+            "shed_by_reason": report["shed_by_reason"],
+            "accounting": report["accounting"],
+            "health": report.get("health"),
+            "epoch_rows": report["stream"]["rows"],
+            "replay": replay,
+            "replay_wall_s": round(wall_s, 2),
+            "sched_config": {
+                "batch_target": sched_cfg.batch_target,
+                "dispatch_ms": sched_cfg.dispatch_ms,
+                "queue_cap": sched_cfg.queue_cap,
+                "tenant_quota": sched_cfg.tenant_quota,
+                "cache": sched_cfg.cache,
             },
             "device": dev,
             "stages": _stage_report(),
@@ -1385,6 +1580,9 @@ if __name__ == "__main__":
         elif (os.environ.get("BENCH_MODE") == "slot-load"
                 or "--slot-load" in sys.argv):
             slot_load_mode()
+        elif (os.environ.get("BENCH_MODE") == "stream"
+                or "--stream" in sys.argv):
+            stream_mode()
         elif (os.environ.get("BENCH_MODE") == "slot-chain"
                 or "--slot-chain" in sys.argv):
             slot_chain_mode()

@@ -226,6 +226,38 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_SLO_BUDGET_MS", "float", 4000.0,
          "p99 enqueue->verdict budget for the within_budget SLO verdict",
          "lighthouse_tpu/loadgen/serve.py"),
+    # -------------------------------------------------- loadgen/slo.py
+    Knob("LHTPU_SLO_SAMPLE_CAP", "int", 8192,
+         "Per-work-type latency sample window (exact quantiles within it); totals stay exact",
+         "lighthouse_tpu/loadgen/slo.py"),
+    # -------------------------------------------- loadgen/scheduler.py
+    Knob("LHTPU_SCHED_BLOCK_DEADLINE_MS", "float", 0.0,
+         "Block-class coalescing deadline; 0 = dispatch immediately, preempting any window",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_AGG_DEADLINE_MS", "float", 100.0,
+         "Aggregate-class coalescing deadline before a partial batch fires",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_ATT_DEADLINE_MS", "float", 250.0,
+         "Attestation-class coalescing deadline before a partial batch fires",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_SYNC_DEADLINE_MS", "float", 500.0,
+         "Sync-class coalescing deadline before a partial batch fires",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_QUEUE_CAP", "int", 16384,
+         "Per-class queue capacity in the continuous scheduler (shed watermarks scale off it)",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_TENANT_QUOTA", "float", 0.5,
+         "Max fraction of a class's shed watermark one tenant may occupy before its offers shed",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_DISPATCH_MS", "float", 0.0,
+         "Modeled per-chunk device occupancy on the virtual clock (enables deterministic preemption windows)",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_CACHE", "bool", True,
+         "Cross-slot committee-composition pubkey cache on (1) / off (0)",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_CACHE_CAP", "int", 4096,
+         "Composition-cache entry capacity (LRU beyond it)",
+         "lighthouse_tpu/loadgen/scheduler.py"),
     # ------------------------------------------------- loadgen/soak.py
     Knob("LHTPU_CHAOS_SCHEDULE", "str", "",
          "Soak chaos plan: epoch:stage:kind:count[;...] layered on the fault injector",

@@ -112,6 +112,15 @@ class DeadlineExceeded(TimeoutError):
     instead of an indefinite hang."""
 
 
+class BatchPreempted(InterruptedError):
+    """A coalesced batch lost its dispatch window to a higher class
+    (the continuous scheduler preempting aggregates/attestations for a
+    block). Transient by construction: the abandoned events re-enqueue
+    at the front of their lanes exactly once and re-dispatch after the
+    preempting work — any layer that observes the abort must retry in
+    place, never degrade a rung or count a verdict."""
+
+
 # --------------------------------------------------------------- classifier
 
 # Message substrings (lowercased match) -> retry-worthiness. PERMANENT
@@ -183,6 +192,8 @@ def classify(exc: BaseException) -> tuple[str, str]:
     still rescues the verdict."""
     if isinstance(exc, DeadlineExceeded):
         return TRANSIENT, "hang"
+    if isinstance(exc, BatchPreempted):
+        return TRANSIENT, "preempted"
     msg = f"{type(exc).__name__}: {exc}".lower()
     if isinstance(exc, _PERMANENT_TYPES):
         for pattern, kind in _PERMANENT_PATTERNS:
@@ -443,6 +454,9 @@ _FAULT_FACTORIES = {
     "chip_loss": lambda: RuntimeError(
         "INTERNAL: Device lost: TPU chip removed from mesh "
         "(interconnect failure) [injected]"
+    ),
+    "preempted": lambda: BatchPreempted(
+        "coalesced batch preempted by higher-class work [injected]"
     ),
 }
 

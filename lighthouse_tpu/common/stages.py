@@ -33,6 +33,8 @@ CANONICAL_STAGES: tuple[str, ...] = (
     # Off-ladder stages.
     "native_fallback",  # pure-CPU backend rung of the degradation ladder
     "bench_device",     # bench.py's forced device probe dispatches
+    # Host-side scheduler stages (loadgen/scheduler.py).
+    "sched_cache",      # cross-slot committee-composition pubkey cache
 )
 
 _STAGE_SET = frozenset(CANONICAL_STAGES)

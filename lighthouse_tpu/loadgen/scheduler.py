@@ -1,0 +1,721 @@
+"""Continuous cross-slot batching scheduler (ISSUE 15 tentpole).
+
+Sits between ``loadgen/traffic.py`` streams and the dispatch engine and
+turns the single-slot ServingLoop into a multi-tenant service: traffic
+is a continuous multi-epoch stream of blocks (latency-critical),
+aggregates, unaggregated attestations, and sync messages from many
+peers, and the scheduler's job is to keep blocks inside their SLO under
+overload, chaos, and degraded rungs — shedding the sheddable, never the
+chain-critical (SURVEY §2.3 / §7.3 latency discipline).
+
+Four mechanisms, all deterministic on the virtual clock:
+
+* **Priority classes with per-class deadlines** — every WorkType maps
+  to a :class:`~lighthouse_tpu.network.processor.WorkClass`; a class's
+  batch fires when it reaches ``batch_target`` or its oldest event has
+  waited that class's deadline. Blocks default to a zero deadline:
+  they dispatch immediately and **preempt** the coalescing window of
+  any lower class mid-batch — the un-dispatched remainder re-enqueues
+  at the front of its lanes *exactly once* (a re-enqueued batch is
+  never preempted again, so preemption can delay but not starve), and
+  the abandonment is classified through
+  ``resilience.classify(BatchPreempted(...))`` as a transient: retried
+  in place, never a rung degradation, never a verdict.
+* **Weighted per-tenant fairness** — each class queue is a set of
+  per-peer FIFO lanes drained round-robin, so one hot peer cannot fill
+  a batch; admission enforces a per-tenant quota (a fraction of the
+  class's shed watermark) before the global watermark engages.
+* **Health-governed shedding** — class shed watermarks scale with
+  ``health.current_state()``: DEGRADED halves them (low classes shed
+  earlier), CRITICAL sheds every sheddable offer at ingress —
+  blocks-only mode. Blocks are never shed and never quota-limited.
+* **Cross-slot composition cache** — committee compositions repeat all
+  epoch, so the aggregate public key of a (pubkey-set) composition is
+  cached across slots (PR-10's protocol-aware dedup lifted one level
+  up) and a K-pubkey set folds to an equivalent single-pubkey set
+  host-side before dispatch. The cache key is the composition alone —
+  signature and message ride through untouched — so a cache hit can
+  never alias a verdict; a cache *fault* (injectable at the
+  ``sched_cache`` stage) degrades in place to the identity transform.
+
+``StreamRunner`` drives one scheduler instance across epochs on one
+clock (queues and cache persist — the cross-slot part) with the soak
+chaos schedule installed per epoch, and is what ``bench.py --stream``
+and the fault-drill continuous rows run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field, replace
+
+from ..common import health, knobs, pipeline, resilience
+from ..crypto.bls import api as bls_api
+from ..network.processor import (
+    CLASS_PRIORITY, WorkClass, WorkEvent, work_class,
+)
+from . import slo
+from .serve import VirtualClock, WallClock, verdict_digest
+from .soak import chaos_spec_for_epoch, parse_chaos_schedule
+from .traffic import TimedEvent, TrafficConfig, TrafficGenerator
+
+__all__ = [
+    "SchedulerConfig", "StreamScheduler", "StreamRunner",
+    "CompositionCache", "continuous_digest",
+]
+
+#: classes that may be shed (priority order: SYNC sheds first). BLOCK is
+#: chain liveness — never shed, never dropped by admission.
+_SHEDDABLE_CLASSES = (
+    WorkClass.AGGREGATE, WorkClass.ATTESTATION, WorkClass.SYNC,
+)
+#: fraction of the class queue cap at which each class's shed watermark
+#: sits while HEALTHY — lower classes shed earlier by construction.
+_CLASS_WATERMARK = {
+    WorkClass.AGGREGATE: 0.75,
+    WorkClass.ATTESTATION: 0.50,
+    WorkClass.SYNC: 0.25,
+}
+
+
+@dataclass
+class SchedulerConfig:
+    batch_target: int = 256        # full-batch dispatch size per class
+    # per-class coalescing deadlines (ms); block=0 → immediate dispatch
+    block_deadline_ms: float = 0.0
+    agg_deadline_ms: float = 100.0
+    att_deadline_ms: float = 250.0
+    sync_deadline_ms: float = 500.0
+    queue_cap: int = 16384         # per sheddable class; watermarks scale off it
+    tenant_quota: float = 0.5      # tenant's share of a class watermark
+    dispatch_ms: float = 0.0       # modeled per-chunk device occupancy
+    cache: bool = True             # cross-slot composition cache
+    cache_cap: int = 4096
+    slo_budget_ms: float = 4000.0  # p99 budget (block class is the headline)
+
+    def deadline_ms(self, cls: WorkClass) -> float:
+        return {
+            WorkClass.BLOCK: self.block_deadline_ms,
+            WorkClass.AGGREGATE: self.agg_deadline_ms,
+            WorkClass.ATTESTATION: self.att_deadline_ms,
+            WorkClass.SYNC: self.sync_deadline_ms,
+        }[cls]
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SchedulerConfig":
+        """LHTPU_SCHED_* family (+ LHTPU_BATCH_TARGET /
+        LHTPU_SLO_BUDGET_MS shared with the serving loop), explicit
+        ``overrides`` winning."""
+        cfg = {
+            "batch_target": int(knobs.knob("LHTPU_BATCH_TARGET")),
+            "block_deadline_ms": knobs.knob("LHTPU_SCHED_BLOCK_DEADLINE_MS"),
+            "agg_deadline_ms": knobs.knob("LHTPU_SCHED_AGG_DEADLINE_MS"),
+            "att_deadline_ms": knobs.knob("LHTPU_SCHED_ATT_DEADLINE_MS"),
+            "sync_deadline_ms": knobs.knob("LHTPU_SCHED_SYNC_DEADLINE_MS"),
+            "queue_cap": int(knobs.knob("LHTPU_SCHED_QUEUE_CAP")),
+            "tenant_quota": knobs.knob("LHTPU_SCHED_TENANT_QUOTA"),
+            "dispatch_ms": knobs.knob("LHTPU_SCHED_DISPATCH_MS"),
+            "cache": bool(knobs.knob("LHTPU_SCHED_CACHE")),
+            "cache_cap": int(knobs.knob("LHTPU_SCHED_CACHE_CAP")),
+            "slo_budget_ms": knobs.knob("LHTPU_SLO_BUDGET_MS"),
+        }
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+# ------------------------------------------------------------------ lanes
+
+@dataclass
+class _Lanes:
+    """One class's queue: per-tenant FIFO lanes drained round-robin.
+
+    Entries are ``(enqueue_t, WorkEvent)``; ``requeue_front`` restores a
+    preempted remainder at the lane heads with original timestamps, so
+    recorded latency includes the preemption delay."""
+
+    cap: int
+    lanes: dict[str, deque] = field(default_factory=dict)
+    rr: deque = field(default_factory=deque)  # tenants with work, RR order
+    depth: int = 0
+    dropped: int = 0
+
+    def tenant_depth(self, tenant: str) -> int:
+        lane = self.lanes.get(tenant)
+        return len(lane) if lane else 0
+
+    def push(self, tenant: str, t: float, event: WorkEvent) -> bool:
+        if self.depth >= self.cap:
+            self.dropped += 1
+            return False
+        lane = self.lanes.get(tenant)
+        if lane is None:
+            lane = self.lanes[tenant] = deque()
+        if not lane:
+            self.rr.append(tenant)
+        lane.append((t, event))
+        self.depth += 1
+        return True
+
+    def pop(self):
+        """Next ``(t, event)`` in round-robin tenant order."""
+        tenant = self.rr[0]
+        lane = self.lanes[tenant]
+        item = lane.popleft()
+        self.rr.popleft()
+        if lane:
+            self.rr.append(tenant)
+        self.depth -= 1
+        return item
+
+    def requeue_front(self, items: list[tuple[float, WorkEvent]]) -> None:
+        """Preempted remainder back to the lane HEADS, batch order
+        preserved per tenant (iterate reversed + appendleft)."""
+        for t, ev in reversed(items):
+            tenant = ev.peer_id or ""
+            lane = self.lanes.get(tenant)
+            if lane is None:
+                lane = self.lanes[tenant] = deque()
+            if not lane:
+                self.rr.appendleft(tenant)
+            lane.appendleft((t, ev))
+            self.depth += 1
+
+    def oldest_t(self) -> float | None:
+        heads = [lane[0][0] for lane in self.lanes.values() if lane]
+        return min(heads) if heads else None
+
+
+# ------------------------------------------------------------------ cache
+
+class CompositionCache:
+    """Cross-slot aggregate-pubkey cache keyed on committee composition.
+
+    A committee's composition (its ordered pubkey set) repeats every
+    slot of an epoch; aggregating its public keys host-side is O(K)
+    point-adds that this cache pays once per composition instead of
+    once per set. ``fold`` rewrites a K-pubkey SignatureSet into the
+    equivalent single-pubkey set over the cached aggregate — same
+    signature, same message, bit-identical verdict math (e(sig, G) =
+    e(H(m), Σpk)) — so a *hit can never alias a verdict*: nothing
+    signature- or message-dependent is ever cached. Any fault in the
+    cache path (injectable at the canonical ``sched_cache`` stage)
+    degrades in place to the identity transform and is classified, so
+    chaos runs stay digest-identical to clean runs."""
+
+    def __init__(self, cap: int = 4096, enabled: bool = True):
+        self.cap = max(1, int(cap))
+        self.enabled = bool(enabled)
+        self._entries: OrderedDict[bytes, object] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.bypass = 0
+        self.faults = 0
+        self.fault_kinds: dict[str, int] = {}
+
+    @staticmethod
+    def _key(keys) -> bytes:
+        h = hashlib.sha256()
+        for pk in keys:
+            h.update(pk.to_bytes())
+        return h.digest()
+
+    def fold(self, sig_set):
+        if not self.enabled:
+            self.bypass += 1
+            slo.SCHED_CACHE_EVENTS.inc(event="bypass")
+            return sig_set
+        keys = sig_set.signing_keys
+        if len(keys) <= 1:
+            self.bypass += 1
+            slo.SCHED_CACHE_EVENTS.inc(event="bypass")
+            return sig_set
+        try:
+            resilience.maybe_inject("sched_cache")
+            ck = self._key(keys)
+            agg = self._entries.get(ck)
+            if agg is None:
+                agg = bls_api.aggregate_pubkeys(list(keys))
+                self._entries[ck] = agg
+                while len(self._entries) > self.cap:
+                    self._entries.popitem(last=False)
+                self.misses += 1
+                slo.SCHED_CACHE_EVENTS.inc(event="miss")
+            else:
+                self._entries.move_to_end(ck)
+                self.hits += 1
+                slo.SCHED_CACHE_EVENTS.inc(event="hit")
+            return bls_api.SignatureSet.single_pubkey(
+                sig_set.signature, agg, sig_set.message
+            )
+        except Exception as exc:
+            # Identity fallback: the original multi-pubkey set dispatches
+            # unchanged — a cache fault costs the dedup win, never a
+            # verdict. Classified so drills can see the kind.
+            _, kind = resilience.classify(exc)
+            self.faults += 1
+            self.fault_kinds[kind] = self.fault_kinds.get(kind, 0) + 1
+            slo.SCHED_CACHE_EVENTS.inc(event="fault")
+            return sig_set
+
+    def report(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "entries": len(self._entries),
+            "cap": self.cap,
+            "hits": self.hits,
+            "misses": self.misses,
+            "bypass": self.bypass,
+            "faults": self.faults,
+            "fault_kinds": dict(self.fault_kinds),
+        }
+
+
+# -------------------------------------------------------------- scheduler
+
+class StreamScheduler:
+    """Class-prioritized, tenant-fair, preemptive continuous scheduler."""
+
+    def __init__(self, config: SchedulerConfig | None = None, *,
+                 clock=None, backend: str | None = None, verify=None):
+        self.cfg = config or SchedulerConfig()
+        self.clock = clock or WallClock()
+        self.backend = backend
+        self._verify = verify or (
+            lambda sets: bls_api.verify_signature_sets_triaged(
+                sets, backend=self.backend
+            )
+        )
+        self.cache = CompositionCache(
+            cap=self.cfg.cache_cap, enabled=self.cfg.cache
+        )
+        block_cap = max(self.cfg.queue_cap, 65536)  # blocks must not drop
+        self.lanes: dict[WorkClass, _Lanes] = {
+            cls: _Lanes(cap=block_cap if cls is WorkClass.BLOCK
+                        else self.cfg.queue_cap)
+            for cls in CLASS_PRIORITY
+        }
+        self.recorder = slo.LatencyRecorder()
+        self.verdicts: dict[int, bool] = {}
+        self.mismatches = 0
+        self.offered = 0
+        self.admitted = 0
+        self.shed_by_class: dict[str, int] = {}
+        self.shed_by_reason: dict[str, int] = {}
+        self.shed_by_tenant: dict[str, int] = {}
+        self.served_by_class: dict[str, int] = {}
+        self.preempted_batches = 0
+        self.preempted_by_class: dict[str, int] = {}
+        self.requeued_by_class: dict[str, int] = {}
+        self.batches = 0
+        self._pending: deque[tuple[float, WorkEvent]] = deque()
+
+    # ---------------------------------------------------------- admission
+    def _watermark(self, cls: WorkClass) -> int:
+        """Class shed watermark under the current governor state: the
+        queue depth at which this class's offers shed. 0 = shed every
+        offer (CRITICAL: blocks-only)."""
+        base = self.cfg.queue_cap * _CLASS_WATERMARK[cls]
+        state = health.current_state()
+        if state >= health.CRITICAL:
+            return 0
+        if state >= health.DEGRADED:
+            base /= 2.0
+        return max(1, int(base))
+
+    def _shed(self, cls: WorkClass, tenant: str, reason: str) -> None:
+        c = cls.value
+        self.shed_by_class[c] = self.shed_by_class.get(c, 0) + 1
+        self.shed_by_reason[reason] = self.shed_by_reason.get(reason, 0) + 1
+        self.shed_by_tenant[tenant] = self.shed_by_tenant.get(tenant, 0) + 1
+        slo.SCHED_SHED.inc(work_class=c, reason=reason)
+
+    def offer(self, event: WorkEvent, t: float | None = None) -> bool:
+        """Admission-gated enqueue at time ``t`` (default: now).
+        Returns False when shed or dropped."""
+        now = self.clock.now() if t is None else t
+        cls = work_class(event.work_type)
+        tenant = event.peer_id or ""
+        lanes = self.lanes[cls]
+        self.offered += 1
+        if cls is not WorkClass.BLOCK:
+            mark = self._watermark(cls)
+            if mark <= 0 or lanes.depth >= mark:
+                reason = "blocks_only" if mark <= 0 else "watermark"
+                self._shed(cls, tenant, reason)
+                return False
+            quota = max(1, int(self.cfg.tenant_quota * mark))
+            if lanes.tenant_depth(tenant) >= quota:
+                self._shed(cls, tenant, "tenant_quota")
+                return False
+        if not lanes.push(tenant, now, event):
+            return False  # class cap (counted in lanes.dropped)
+        self.admitted += 1
+        slo.SCHED_QUEUE_DEPTH.set(lanes.depth, work_class=cls.value)
+        return True
+
+    # ----------------------------------------------------------- due math
+    def _due(self, cls: WorkClass) -> bool:
+        lanes = self.lanes[cls]
+        if lanes.depth == 0:
+            return False
+        if lanes.depth >= self.cfg.batch_target:
+            return True
+        oldest = lanes.oldest_t()
+        waited_ms = (self.clock.now() - oldest) * 1e3
+        return waited_ms >= self.cfg.deadline_ms(cls)
+
+    def _next_due_ms(self) -> float | None:
+        """Milliseconds until the earliest class becomes due; None when
+        all queues are empty."""
+        best: float | None = None
+        now = self.clock.now()
+        for cls in CLASS_PRIORITY:
+            lanes = self.lanes[cls]
+            if lanes.depth == 0:
+                continue
+            if lanes.depth >= self.cfg.batch_target:
+                return 0.0
+            waited_ms = (now - lanes.oldest_t()) * 1e3
+            remain = max(0.0, self.cfg.deadline_ms(cls) - waited_ms)
+            best = remain if best is None else min(best, remain)
+        return best
+
+    # ----------------------------------------------------------- dispatch
+    def _quantum(self) -> int:
+        """Preemption granularity, delegated to the parallel engine so
+        chunks stay mesh-shaped under sharding."""
+        try:
+            from ..parallel import engine
+
+            return engine.dispatch_quantum(self.cfg.batch_target)
+        except Exception:  # lhtpu: ignore[LH502] -- engine needs jax; injected-verify unit tests run without it
+            return max(1, self.cfg.batch_target // 4)
+
+    def _form(self, cls: WorkClass) -> list[tuple[float, WorkEvent]]:
+        lanes = self.lanes[cls]
+        out = []
+        while lanes.depth > 0 and len(out) < self.cfg.batch_target:
+            out.append(lanes.pop())
+        slo.SCHED_QUEUE_DEPTH.set(lanes.depth, work_class=cls.value)
+        return out
+
+    def _verify_chunk(self, items: list[tuple[float, WorkEvent]]) -> None:
+        sets = [self.cache.fold(ev.payload.sig_set) for _, ev in items]
+        verdicts = self._verify(sets)
+        pipeline.note_progress()
+        if self.cfg.dispatch_ms > 0:
+            self.clock.sleep_until(
+                self.clock.now() + self.cfg.dispatch_ms / 1e3
+            )
+        t1 = self.clock.now()
+        for (t0, ev), ok in zip(items, verdicts):
+            p = ev.payload
+            self.verdicts[p.seq] = bool(ok)
+            if bool(ok) != p.expected:
+                self.mismatches += 1
+                slo.VERDICT_MISMATCHES.inc()
+            wt = ev.work_type.value
+            self.recorder.observe(wt, max(0.0, t1 - t0))
+            c = work_class(ev.work_type).value
+            self.served_by_class[c] = self.served_by_class.get(c, 0) + 1
+
+    def _dispatch_batch(self, cls: WorkClass,
+                        items: list[tuple[float, WorkEvent]]) -> None:
+        """Dispatch ``items`` in engine-quantum chunks, feeding arrivals
+        between chunks; a block arriving mid-batch preempts the
+        remainder of any non-block batch — unless any event in it was
+        already preempted once (exactly-once re-enqueue, no
+        starvation)."""
+        self.batches += 1
+        quantum = len(items) if self.cfg.dispatch_ms <= 0 else self._quantum()
+        preemptible = cls is not WorkClass.BLOCK and not any(
+            getattr(ev, "_sched_preempted", False) for _, ev in items
+        )
+        i = 0
+        while i < len(items):
+            chunk = items[i:i + quantum]
+            self._verify_chunk(chunk)
+            i += quantum
+            self._feed_due()
+            if (preemptible and i < len(items)
+                    and self.lanes[WorkClass.BLOCK].depth > 0):
+                remainder = items[i:]
+                for _, ev in remainder:
+                    ev._sched_preempted = True
+                self.lanes[cls].requeue_front(remainder)
+                c = cls.value
+                self.preempted_batches += 1
+                self.preempted_by_class[c] = (
+                    self.preempted_by_class.get(c, 0) + 1
+                )
+                self.requeued_by_class[c] = (
+                    self.requeued_by_class.get(c, 0) + len(remainder)
+                )
+                slo.SCHED_PREEMPTIONS.inc(work_class=c)
+                slo.SCHED_REQUEUED.inc(len(remainder), work_class=c)
+                # The abandoned window is a classified transient — any
+                # observer retries in place, never degrades a rung.
+                cat, kind = resilience.classify(resilience.BatchPreempted(
+                    f"{c} batch preempted by block after "
+                    f"{i}/{len(items)} events"
+                ))
+                assert (cat, kind) == (resilience.TRANSIENT, "preempted")
+                return
+
+    def _dispatch_due_once(self) -> bool:
+        """One scheduling decision: blocks first, then the highest
+        priority class that is due. Returns True if work dispatched."""
+        if self.lanes[WorkClass.BLOCK].depth > 0 \
+                and self._due(WorkClass.BLOCK):
+            self._dispatch_batch(
+                WorkClass.BLOCK, self._form(WorkClass.BLOCK)
+            )
+            return True
+        for cls in CLASS_PRIORITY[1:]:
+            if self._due(cls):
+                self._dispatch_batch(cls, self._form(cls))
+                return True
+        return False
+
+    # -------------------------------------------------------------- drive
+    def _feed_due(self) -> None:
+        now = self.clock.now()
+        while self._pending and self._pending[0][0] <= now:
+            t, ev = self._pending.popleft()
+            self.offer(ev, t)
+
+    def _total_depth(self) -> int:
+        return sum(lanes.depth for lanes in self.lanes.values())
+
+    def run_segment(self, events: list[TimedEvent]) -> None:
+        """Feed one timestamped stream segment (timestamps relative to
+        the current clock) and drain it to empty. Queues, cache, and
+        counters persist across segments — call once per epoch for a
+        continuous cross-slot run, then ``finish()``."""
+        base = self.clock.now()
+        for te in events:
+            self._pending.append((base + te.t, te.event))
+        while self._pending or self._total_depth() > 0:
+            self._feed_due()
+            if self._dispatch_due_once():
+                continue
+            targets = []
+            if self._pending:
+                targets.append(self._pending[0][0])
+            nd = self._next_due_ms()
+            if nd is not None:
+                # 1ns past the deadline (serve.py livelock guard).
+                targets.append(self.clock.now() + nd / 1e3 + 1e-9)
+            if not targets:
+                break
+            self.clock.sleep_until(min(targets))
+
+    def run(self, events: list[TimedEvent]) -> dict:
+        self.run_segment(events)
+        return self.finish()
+
+    # ------------------------------------------------------------- report
+    def snapshot(self) -> dict:
+        """Cumulative counters for per-epoch delta rows."""
+        return {
+            "offered": self.offered,
+            "admitted": self.admitted,
+            "served": self.recorder.count(),
+            "shed": sum(self.shed_by_class.values()),
+            "dropped": self._dropped(),
+            "preempted_batches": self.preempted_batches,
+            "requeued": sum(self.requeued_by_class.values()),
+            "mismatches": self.mismatches,
+            "batches": self.batches,
+            "cache_hits": self.cache.hits,
+            "cache_faults": self.cache.faults,
+        }
+
+    def _dropped(self) -> int:
+        return sum(lanes.dropped for lanes in self.lanes.values())
+
+    def per_class_report(self) -> dict:
+        lat = self.recorder.class_summary()
+        out = {}
+        for cls in CLASS_PRIORITY:
+            c = cls.value
+            entry = dict(lat.get(c, {
+                "count": 0, "window": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+                "p99_ms": 0.0, "max_ms": 0.0,
+            }))
+            entry.update({
+                "served": self.served_by_class.get(c, 0),
+                "shed": self.shed_by_class.get(c, 0),
+                "dropped": self.lanes[cls].dropped,
+                "preempted_batches": self.preempted_by_class.get(c, 0),
+                "requeued": self.requeued_by_class.get(c, 0),
+                "pending": self.lanes[cls].depth,
+            })
+            out[c] = entry
+        return out
+
+    def finish(self) -> dict:
+        lat = self.recorder.summary()
+        overall = lat["overall"]
+        per_class = self.per_class_report()
+        served = self.recorder.count()
+        shed = sum(self.shed_by_class.values())
+        dropped = self._dropped()
+        pending = self._total_depth() + len(self._pending)
+        # Disjoint-outcome identity: preempted events re-enqueue and are
+        # eventually served ONCE — they appear in no other bucket.
+        accounted = served + shed + dropped + pending
+        block = per_class[WorkClass.BLOCK.value]
+        report = {
+            "slo": {
+                "p50_ms": overall["p50_ms"],
+                "p95_ms": overall["p95_ms"],
+                "p99_ms": overall["p99_ms"],
+                "shed": shed,
+                "dropped": dropped,
+                "within_budget": bool(
+                    overall["count"] > 0
+                    and overall["p99_ms"] <= self.cfg.slo_budget_ms
+                ),
+                "budget_ms": self.cfg.slo_budget_ms,
+                "per_class": per_class,
+            },
+            "latency_ms": lat,
+            "events_offered": self.offered,
+            "events_admitted": self.admitted,
+            "events_served": served,
+            "shed_by_class": dict(self.shed_by_class),
+            "shed_by_reason": dict(self.shed_by_reason),
+            "sched": {
+                "preempted_batches": self.preempted_batches,
+                "preempted_by_class": dict(self.preempted_by_class),
+                "requeued_by_class": dict(self.requeued_by_class),
+                "batches": self.batches,
+                "cache": self.cache.report(),
+                "tenants_shed": len(self.shed_by_tenant),
+                "block": {
+                    "shed": self.shed_by_class.get(
+                        WorkClass.BLOCK.value, 0),
+                    "dropped": block["dropped"],
+                    "p99_ms": block["p99_ms"],
+                    "within_budget": bool(
+                        block["served"] == 0
+                        or block["p99_ms"] <= self.cfg.slo_budget_ms
+                    ),
+                },
+            },
+            "accounting": {
+                "served": served,
+                "shed": shed,
+                "dropped": dropped,
+                "pending": pending,
+                "balanced": accounted == self.offered,
+            },
+            "health": health.health_report() if health._GOVERNOR else None,
+            "verdicts": {
+                "served": len(self.verdicts),
+                "valid": sum(1 for v in self.verdicts.values() if v),
+                "invalid": sum(
+                    1 for v in self.verdicts.values() if not v),
+                "mismatches": self.mismatches,
+            },
+        }
+        health.note_slo(overall["p99_ms"], self.cfg.slo_budget_ms)
+        slo.set_last_report(report)
+        return report
+
+
+# ----------------------------------------------------------------- runner
+
+def continuous_digest(verdicts: dict[int, bool]) -> str:
+    """Alias of :func:`serve.verdict_digest` — the chaos-parity
+    fingerprint for continuous runs."""
+    return verdict_digest(verdicts)
+
+
+class StreamRunner:
+    """Multi-epoch continuous driver: one StreamScheduler fed epoch
+    streams back-to-back on one clock, so queues and the composition
+    cache persist across epochs (the cross-slot part), with the soak
+    chaos schedule (``LHTPU_CHAOS_SCHEDULE``) installed per epoch.
+
+    Event seqs are renumbered with a per-epoch stride so the verdict
+    dict spans the whole run; the final report's ``verdict_digest`` is
+    the chaos-parity fingerprint — a chaos run must match its
+    chaos-free replay bit-for-bit."""
+
+    SEQ_STRIDE = 10_000_000
+    SEED_STRIDE = 7919  # soak's per-epoch seed stride
+
+    def __init__(self, traffic: TrafficConfig, epochs: int,
+                 config: SchedulerConfig | None = None, *,
+                 clock=None, backend: str | None = None, verify=None,
+                 chaos: str | None = None, emit=None):
+        self.traffic = traffic
+        self.epochs = max(1, int(epochs))
+        self.cfg = config or SchedulerConfig()
+        self.clock = clock or VirtualClock()
+        self.backend = backend
+        self.verify = verify
+        self.chaos = parse_chaos_schedule(
+            knobs.knob("LHTPU_CHAOS_SCHEDULE") if chaos is None else chaos
+        )
+        self.emit = emit
+
+    def _epoch_events(self, epoch: int) -> list[TimedEvent]:
+        cfg = replace(
+            self.traffic, seed=self.traffic.seed + self.SEED_STRIDE * epoch
+        )
+        events = TrafficGenerator(cfg).generate()
+        for te in events:
+            te.payload.seq += self.SEQ_STRIDE * epoch
+        return events
+
+    def run(self) -> dict:
+        sched = StreamScheduler(
+            self.cfg, clock=self.clock, backend=self.backend,
+            verify=self.verify,
+        )
+        expected_total = 0
+        rows: list[dict] = []
+        prev = sched.snapshot()
+        saved_inject = knobs.raw("LHTPU_FAULT_INJECT")
+        try:
+            for epoch in range(self.epochs):
+                spec = chaos_spec_for_epoch(self.chaos, epoch)
+                if spec:
+                    os.environ["LHTPU_FAULT_INJECT"] = spec
+                    resilience.rearm_faults()
+                else:
+                    os.environ.pop("LHTPU_FAULT_INJECT", None)
+                events = self._epoch_events(epoch)
+                expected_total += len(events)
+                t0 = self.clock.now()
+                sched.run_segment(events)
+                snap = sched.snapshot()
+                row = {
+                    "epoch": epoch,
+                    "chaos": spec,
+                    "virtual_s": round(self.clock.now() - t0, 6),
+                    **{k: snap[k] - prev[k] for k in snap},
+                }
+                prev = snap
+                rows.append(row)
+                if self.emit is not None:
+                    self.emit(row)
+        finally:
+            if saved_inject is None:
+                os.environ.pop("LHTPU_FAULT_INJECT", None)
+            else:
+                os.environ["LHTPU_FAULT_INJECT"] = saved_inject
+        report = sched.finish()
+        report["stream"] = {
+            "epochs": self.epochs,
+            "events": expected_total,
+            "rows": rows,
+            "verdict_digest": verdict_digest(sched.verdicts),
+            "chaos": bool(self.chaos),
+        }
+        return report

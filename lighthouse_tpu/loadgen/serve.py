@@ -35,7 +35,9 @@ from dataclasses import dataclass
 
 from ..common import health, knobs, pipeline
 from ..crypto.bls import api as bls_api
-from ..network.processor import BATCHED, BeaconProcessor, WorkEvent, WorkType
+from ..network.processor import (
+    BATCHED, BeaconProcessor, WorkEvent, WorkType, work_class,
+)
 from . import slo
 from .traffic import TimedEvent
 
@@ -350,6 +352,25 @@ class ServingLoop:
         dropped = sum(dropped_by_type.values())
         force_degraded = sum(self.force_degraded_by_type.values())
         served = self.recorder.count()
+        # Per-work-class breakdown (ISSUE 15): latency windows merged by
+        # scheduling class, shed/dropped counts mapped the same way —
+        # the class-level half of /slo and detail.slo.
+        per_class = self.recorder.class_summary()
+
+        def _by_class(by_type: dict[str, int]) -> dict[str, int]:
+            out: dict[str, int] = {}
+            for wt, n in by_type.items():
+                c = work_class(WorkType(wt)).value
+                out[c] = out.get(c, 0) + n
+            return out
+
+        shed_by_class = _by_class(self.shed_by_type)
+        dropped_by_class = _by_class(dropped_by_type)
+        for c in sorted(
+                set(per_class) | set(shed_by_class) | set(dropped_by_class)):
+            entry = per_class.setdefault(c, {"count": 0})
+            entry["shed"] = shed_by_class.get(c, 0)
+            entry["dropped"] = dropped_by_class.get(c, 0)
         # Disjoint-outcome identity: everything offered was served, shed
         # at admission, dropped by a full queue, force-degraded by the
         # watchdog, or is still pending — each event in exactly one
@@ -369,6 +390,7 @@ class ServingLoop:
                     and overall["p99_ms"] <= self.cfg.slo_budget_ms
                 ),
                 "budget_ms": self.cfg.slo_budget_ms,
+                "per_class": per_class,
             },
             "latency_ms": lat,
             "events_offered": self.events_offered,

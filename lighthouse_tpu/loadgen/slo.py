@@ -18,7 +18,9 @@ The most recent finished run's summary is kept module-global
 from __future__ import annotations
 
 import threading
+from collections import deque
 
+from ..common import knobs
 from ..common.metrics import REGISTRY
 
 SLO_LATENCY_SECONDS = REGISTRY.histogram(
@@ -60,6 +62,33 @@ WATCHDOG_FORCED = REGISTRY.counter(
     "Pending work events force-degraded by the watchdog instead of served",
     ("work_type",),
 )
+# Continuous-scheduler families (loadgen/scheduler.py): class-level
+# admission, preemption, and composition-cache behavior.
+SCHED_SHED = REGISTRY.counter(
+    "loadgen_sched_shed_total",
+    "Offers shed by the continuous scheduler, by class and reason",
+    ("work_class", "reason"),
+)
+SCHED_PREEMPTIONS = REGISTRY.counter(
+    "loadgen_sched_preemptions_total",
+    "Coalesced batches whose dispatch window a block preempted",
+    ("work_class",),
+)
+SCHED_REQUEUED = REGISTRY.counter(
+    "loadgen_sched_requeued_total",
+    "Events re-enqueued (exactly once each) by a batch preemption",
+    ("work_class",),
+)
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "loadgen_sched_queue_depth",
+    "Current continuous-scheduler queue depth per class",
+    ("work_class",),
+)
+SCHED_CACHE_EVENTS = REGISTRY.counter(
+    "loadgen_sched_cache_events_total",
+    "Cross-slot composition-cache outcomes per dispatched set",
+    ("event",),  # hit / miss / bypass / fault
+)
 
 
 def quantile(sorted_samples: list[float], q: float) -> float:
@@ -76,24 +105,47 @@ def quantile(sorted_samples: list[float], q: float) -> float:
 
 
 class LatencyRecorder:
-    """Per-work-type latency samples with exact quantile summaries."""
+    """Per-work-type latency samples with exact quantile summaries.
 
-    def __init__(self):
-        self._samples: dict[str, list[float]] = {}
+    Memory is bounded: each work type keeps a sliding window of the
+    most recent ``cap`` observations (``LHTPU_SLO_SAMPLE_CAP``), so a
+    continuous multi-epoch stream holds recorder RSS flat instead of
+    reading as a leak to the soak health sentinel. Quantiles are exact
+    within the window; event counts (``count`` / summary ``count``
+    fields) stay exact totals over the whole run.
+    """
+
+    def __init__(self, cap: int | None = None):
+        self.cap = int(knobs.knob("LHTPU_SLO_SAMPLE_CAP")) if cap is None \
+            else int(cap)
+        self._windows: dict[str, deque[float]] = {}
+        self._totals: dict[str, int] = {}
 
     def observe(self, work_type: str, seconds: float) -> None:
-        self._samples.setdefault(work_type, []).append(seconds)
+        win = self._windows.get(work_type)
+        if win is None:
+            win = self._windows[work_type] = deque(maxlen=max(1, self.cap))
+        win.append(seconds)
+        self._totals[work_type] = self._totals.get(work_type, 0) + 1
         SLO_LATENCY_SECONDS.observe(seconds, work_type=work_type)
         SERVED_EVENTS.inc(work_type=work_type)
 
     def count(self) -> int:
-        return sum(len(v) for v in self._samples.values())
+        return sum(self._totals.values())
+
+    def count_for(self, work_type: str) -> int:
+        return self._totals.get(work_type, 0)
+
+    def window_size(self) -> int:
+        """Samples currently retained (the memory bound under test)."""
+        return sum(len(v) for v in self._windows.values())
 
     @staticmethod
-    def _summarize(samples: list[float]) -> dict:
+    def _summarize(samples, total: int | None = None) -> dict:
         s = sorted(samples)
         return {
-            "count": len(s),
+            "count": len(s) if total is None else total,
+            "window": len(s),
             "p50_ms": round(quantile(s, 0.50) * 1e3, 3),
             "p95_ms": round(quantile(s, 0.95) * 1e3, 3),
             "p99_ms": round(quantile(s, 0.99) * 1e3, 3),
@@ -102,12 +154,32 @@ class LatencyRecorder:
 
     def summary(self) -> dict:
         """{"overall": {...}, "per_type": {work_type: {...}}}."""
-        merged = [x for v in self._samples.values() for x in v]
+        merged = [x for v in self._windows.values() for x in v]
         return {
-            "overall": self._summarize(merged),
+            "overall": self._summarize(merged, sum(self._totals.values())),
             "per_type": {
-                wt: self._summarize(v) for wt, v in self._samples.items()
+                wt: self._summarize(v, self._totals.get(wt, 0))
+                for wt, v in self._windows.items()
             },
+        }
+
+    def class_summary(self) -> dict:
+        """Latency summaries merged per scheduling class
+        (``network.processor.work_class``): the per-class half of the
+        ``/slo`` and ``detail.slo`` breakdowns."""
+        from ..network.processor import WorkType, work_class
+        windows: dict[str, list[float]] = {}
+        totals: dict[str, int] = {}
+        for wt, win in self._windows.items():
+            try:
+                cls = work_class(WorkType(wt)).value
+            except ValueError:
+                cls = wt  # non-WorkType label: its own bucket
+            windows.setdefault(cls, []).extend(win)
+            totals[cls] = totals.get(cls, 0) + self._totals.get(wt, 0)
+        return {
+            cls: self._summarize(v, totals.get(cls, 0))
+            for cls, v in windows.items()
         }
 
 

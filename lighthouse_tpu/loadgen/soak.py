@@ -292,6 +292,7 @@ class SoakRunner:
                         "p50_ms": report["slo"]["p50_ms"],
                         "p99_ms": report["slo"]["p99_ms"],
                         "within_budget": report["slo"]["within_budget"],
+                        "per_class": report["slo"].get("per_class", {}),
                     },
                     "rss_bytes": rss,
                     "jit_cache_entries": monitoring.jit_cache_entry_count(),

@@ -91,6 +91,7 @@ class TrafficConfig:
     fork_churn_rate: float = 0.0
     skip_slot_prob: float = 0.0
     key_pool: int = 64                # sequential-key fixture pool size
+    peers: int = 16                   # distinct tenant (peer) identities
     seed: int = 1234
     time_scale: float = 1.0           # compress/stretch all timestamps
 
@@ -252,7 +253,8 @@ class TrafficGenerator:
                 t=t * cfg.time_scale,
                 event=WorkEvent(
                     work_type=wt, payload=payload,
-                    peer_id=f"loadgen-{payload.seq % 16}", seen_slot=payload.slot,
+                    peer_id=f"loadgen-{payload.seq % max(1, cfg.peers)}",
+                    seen_slot=payload.slot,
                 ),
             )
             for t, _, wt, payload in raw

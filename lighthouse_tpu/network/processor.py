@@ -98,6 +98,62 @@ class WorkType(str, Enum):
     DELAYED_IMPORT = "delayed_import"
 
 
+class WorkClass(str, Enum):
+    """Scheduling class a work type belongs to (SURVEY §2.3 latency
+    discipline, collapsed to four dispatch priorities).
+
+    ``BLOCK`` is chain-critical — it unblocks attestation processing for
+    the whole slot, so the continuous scheduler (``loadgen/scheduler.py``)
+    dispatches it immediately, preempting any coalescing window, and
+    never sheds it. ``AGGREGATE`` carries the highest verification value
+    per signature (one aggregate ≈ a whole committee) and coalesces only
+    briefly; ``ATTESTATION`` and ``SYNC`` are high-volume, individually
+    low-value gossip that coalesces up to its deadline and sheds first
+    under overload.
+    """
+
+    BLOCK = "block"
+    AGGREGATE = "aggregate"
+    ATTESTATION = "attestation"
+    SYNC = "sync"
+
+
+# Every WorkType maps to exactly one class. Judgment calls mirror the
+# reference's drain priorities: slashings ride with aggregates (rare,
+# chain-impacting), exits/status/range-serving ride with sync messages
+# (deferrable under load).
+WORK_CLASSES: dict[WorkType, WorkClass] = {
+    WorkType.CHAIN_SEGMENT: WorkClass.BLOCK,
+    WorkType.GOSSIP_BLOCK: WorkClass.BLOCK,
+    WorkType.RPC_BLOCK: WorkClass.BLOCK,
+    WorkType.DELAYED_IMPORT: WorkClass.BLOCK,
+    WorkType.GOSSIP_AGGREGATE: WorkClass.AGGREGATE,
+    WorkType.GOSSIP_SYNC_CONTRIBUTION: WorkClass.AGGREGATE,
+    WorkType.GOSSIP_ATTESTER_SLASHING: WorkClass.AGGREGATE,
+    WorkType.GOSSIP_PROPOSER_SLASHING: WorkClass.AGGREGATE,
+    WorkType.GOSSIP_ATTESTATION: WorkClass.ATTESTATION,
+    WorkType.GOSSIP_SYNC_SIGNATURE: WorkClass.SYNC,
+    WorkType.GOSSIP_VOLUNTARY_EXIT: WorkClass.SYNC,
+    WorkType.STATUS: WorkClass.SYNC,
+    WorkType.BLOCKS_BY_RANGE_REQUEST: WorkClass.SYNC,
+    WorkType.BLOCKS_BY_ROOT_REQUEST: WorkClass.SYNC,
+}
+
+# Dispatch order for class-level scheduling; also the reverse of the
+# shed order (SYNC sheds first, BLOCK never sheds).
+CLASS_PRIORITY = (
+    WorkClass.BLOCK,
+    WorkClass.AGGREGATE,
+    WorkClass.ATTESTATION,
+    WorkClass.SYNC,
+)
+
+
+def work_class(work_type: WorkType) -> WorkClass:
+    """The scheduling class for a work type (total over WorkType)."""
+    return WORK_CLASSES[work_type]
+
+
 @dataclass
 class WorkEvent:
     work_type: WorkType

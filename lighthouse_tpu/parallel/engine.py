@@ -236,6 +236,16 @@ def chunk_floor() -> int:
     return top.n_devices * min_sets_per_chip()
 
 
+def dispatch_quantum(batch_target: int) -> int:
+    """Smallest batch slice the continuous scheduler
+    (``loadgen/scheduler.py``) may dispatch — and therefore its block
+    preemption granularity. A quarter of the batch target keeps blocks
+    responsive mid-batch; the mesh chunk floor is the lower bound so a
+    preempted remainder still spans the mesh at min-sets-per-chip when
+    sharding is engaged."""
+    return max(1, chunk_floor(), int(batch_target) // 4)
+
+
 # ----------------------------------------------------- sharded program cache
 
 # (kind, devices, fused, indexed, msm/groups) -> jitted program. All

@@ -627,6 +627,181 @@ def run_drill_soak():
     return results
 
 
+def run_drill_stream():
+    """Continuous-stream drill (ISSUE 15): faults injected into the
+    cross-slot StreamScheduler mid-stream over two epochs of mixed
+    block + aggregate + attestation traffic on the virtual clock.
+
+    Contract per cell (and the scheduler invariants the matrix pins):
+
+    * ``transient mid-stream`` (``dispatch:remote_compile``) — retried
+      in place: zero mismatches, >=1 retry, no rung degradation;
+    * ``permanent mid-stream`` (``dispatch:mosaic``) — degrades down
+      the ladder (>=1 degraded dispatch) with every verdict still
+      correct;
+    * ``cache fault`` (``sched_cache:assert``) — the composition cache
+      degrades to the identity transform in place: >=1 recorded cache
+      fault, zero mismatches (a cache fault may cost the dedup win,
+      never a verdict);
+    * ``preempted`` (no injected fault) — a block arriving inside an
+      attestation coalescing window preempts the remainder, which
+      re-enqueues EXACTLY once: preemptions >=1, every event served
+      once, and the offered == served+shed+dropped+pending accounting
+      identity stays balanced.
+
+    Every cell additionally requires zero blocks shed or dropped."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
+    from lighthouse_tpu.loadgen.scheduler import (
+        SchedulerConfig,
+        StreamRunner,
+        StreamScheduler,
+    )
+    from lighthouse_tpu.loadgen.serve import VirtualClock
+    from lighthouse_tpu.loadgen.traffic import (
+        TimedEvent,
+        TrafficConfig,
+        TrafficGenerator,
+    )
+    from lighthouse_tpu.network.processor import WorkType
+
+    backend = jb.JaxBackend()
+    traffic = TrafficConfig(
+        validators=64, slots=2, seconds_per_slot=2.0,
+        committees_per_slot=2, committee_size=2,
+        unaggregated_per_slot=2, sync_per_slot=0, blocks=True,
+        poison_rate=0.25, key_pool=8, seed=7, peers=4,
+    )
+
+    def _sched_cfg(**over) -> SchedulerConfig:
+        base = dict(
+            batch_target=4, agg_deadline_ms=100.0, att_deadline_ms=100.0,
+            sync_deadline_ms=100.0, dispatch_ms=0.0, cache=False,
+        )
+        base.update(over)
+        return SchedulerConfig(**base)
+
+    def _run(chaos: str, **cfg_over) -> dict:
+        runner = StreamRunner(
+            traffic, 2, _sched_cfg(**cfg_over), clock=VirtualClock(),
+            verify=lambda sets: backend.verify_signature_sets_triaged(sets),
+            chaos=chaos, emit=None,
+        )
+        return runner.run()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+
+    cells = (
+        ("remote_compile", "transient", "0:dispatch:remote_compile:1", {}),
+        ("mosaic", "permanent", "0:dispatch:mosaic:1", {}),
+        ("assert", "cache", "0:sched_cache:assert:1", {"cache": True}),
+        ("preempted", "preempt", "", {}),
+    )
+    results = []
+    try:
+        healthy = _run("")  # healthy warm run (pays the compiles)
+        assert healthy["verdicts"]["mismatches"] == 0, (
+            f"healthy stream run broken: {healthy['verdicts']}"
+        )
+        healthy_path = backend.last_path
+
+        for kind, category, chaos, cfg_over in cells:
+            resilience.reset()
+            retries0 = _total(resilience.RETRIES_TOTAL)
+            degraded0 = _total(resilience.DEGRADED_TOTAL)
+            error = None
+            rep = None
+            preempted = 0
+            try:
+                if category == "preempt":
+                    # Crafted window: a full attestation batch opens at
+                    # t=0 with modeled dispatch occupancy; the block
+                    # lands inside the window and must preempt it.
+                    events = TrafficGenerator(traffic).generate()
+                    atts = [te for te in events if te.event.work_type
+                            is WorkType.GOSSIP_ATTESTATION]
+                    aggs = [te for te in events if te.event.work_type
+                            is WorkType.GOSSIP_AGGREGATE]
+                    blocks = [te for te in events if te.event.work_type
+                              is WorkType.GOSSIP_BLOCK]
+                    stream = [TimedEvent(t=0.0, event=te.event)
+                              for te in atts + aggs]
+                    stream += [TimedEvent(t=0.005 + i * 0.001,
+                                          event=te.event)
+                               for i, te in enumerate(blocks)]
+                    stream.sort(key=lambda te: te.t)
+                    sched = StreamScheduler(
+                        _sched_cfg(batch_target=8, att_deadline_ms=0.0,
+                                   agg_deadline_ms=0.0, dispatch_ms=10.0),
+                        clock=VirtualClock(),
+                        verify=lambda sets:
+                            backend.verify_signature_sets_triaged(sets),
+                    )
+                    rep = sched.run(stream)
+                    preempted = rep["sched"]["preempted_batches"]
+                else:
+                    rep = _run(chaos, **cfg_over)
+                    preempted = rep["sched"]["preempted_batches"]
+            except Exception as exc:  # contract breach, not a crash
+                cat, kind_c = resilience.classify(exc)
+                error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
+            retries = _total(resilience.RETRIES_TOTAL) - retries0
+            degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+            if rep is None:
+                ok = False
+            else:
+                block = rep["sched"]["block"]
+                base_ok = (rep["verdicts"]["mismatches"] == 0
+                           and block["shed"] == 0
+                           and block["dropped"] == 0
+                           and rep["accounting"]["balanced"])
+                if category == "transient":
+                    ok = base_ok and retries >= 1 and degraded == 0
+                elif category == "permanent":
+                    ok = base_ok and degraded >= 1
+                elif category == "cache":
+                    ok = (base_ok
+                          and rep["sched"]["cache"]["faults"] >= 1)
+                else:  # preempt: exactly-once re-enqueue accounting
+                    ok = (base_ok and preempted >= 1
+                          and rep["accounting"]["pending"] == 0
+                          and rep["events_served"]
+                          == rep["events_offered"]
+                          - rep["slo"]["shed"] - rep["slo"]["dropped"])
+            results.append({
+                "mode": "stream",
+                "stage": "sched_cache" if category == "cache"
+                         else "dispatch",
+                "kind": kind,
+                "category": category,
+                "verdict": (rep["verdicts"]["mismatches"] == 0
+                            if rep is not None else None),
+                "retries": retries,
+                "degraded": degraded,
+                "preempted": preempted,
+                "path": backend.last_path,
+                "healthy_path": healthy_path,
+                "error": error,
+                "ok": ok,
+            })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+    return results
+
+
 def main() -> int:
     json_mode = "--json" in sys.argv
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
@@ -653,7 +828,7 @@ def main() -> int:
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     n_multichip = len(MULTICHIP_KINDS) if len(jax.devices()) > 1 else 0
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip + 4}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -671,6 +846,12 @@ def main() -> int:
     # Soak matrix (ISSUE 7): multi-epoch chaos → re-promotion + digest
     # parity; sustained permanents degrade, never crash.
     results += run_drill_soak()
+    # Continuous-stream matrix (ISSUE 15): faults mid-stream through
+    # the cross-slot scheduler — transients retry in place, permanents
+    # degrade down the ladder, a cache fault degrades to the identity
+    # transform, blocks are never shed, and preemption-abandoned
+    # batches re-enqueue exactly once.
+    results += run_drill_stream()
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "
