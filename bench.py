@@ -512,9 +512,21 @@ def stream_mode() -> None:
     set, the same run re-executes chaos-free and the two verdict
     digests must match bit-for-bit (``detail.replay``).
 
+    Chain weather (ISSUE 17): the stream runs a slashing flood by
+    default (BENCH_SLASHING attester/proposer slashing events per
+    committee-slot riding the block-adjacent SLASHING lane, votes fed
+    to the device slasher) and can layer reorg storms
+    (BENCH_REORG probability), non-finality stalls (BENCH_NONFINAL
+    epochs), and sync period boundaries (BENCH_SYNC_PERIOD slots).
+    Each enabled axis is scored as a scenario SLO in
+    ``detail.scenarios`` and folded into the headline ``verified``
+    bit — "slashing flood must not starve attestations, and blocks
+    are never shed" is asserted, not observed.
+
     Knobs: BENCH_EPOCHS / BENCH_OVERLOAD / BENCH_VALIDATORS /
     BENCH_SLOTS / BENCH_POISON / BENCH_SEED / BENCH_SPS / BENCH_UNAGG /
-    BENCH_SYNC / BENCH_WALL=1 (force wall clock), plus the
+    BENCH_SYNC / BENCH_SLASHING / BENCH_REORG / BENCH_NONFINAL /
+    BENCH_SYNC_PERIOD / BENCH_WALL=1 (force wall clock), plus the
     LHTPU_SCHED_* scheduler family."""
     import jax
 
@@ -546,6 +558,10 @@ def stream_mode() -> None:
     sps = float(os.environ.get("BENCH_SPS", "12.0" if tpu else "1.0"))
     unagg = int(os.environ.get("BENCH_UNAGG", "512" if tpu else "32"))
     sync = int(os.environ.get("BENCH_SYNC", "128" if tpu else "16"))
+    slashing = float(os.environ.get("BENCH_SLASHING", "0.5"))
+    reorg = float(os.environ.get("BENCH_REORG", "0.0"))
+    nonfinal = int(os.environ.get("BENCH_NONFINAL", "0"))
+    sync_period = int(os.environ.get("BENCH_SYNC_PERIOD", "0"))
     wall = tpu or os.environ.get("BENCH_WALL") == "1"
 
     committees, csize = slot_shape(N, mainnet_spec())
@@ -566,16 +582,18 @@ def stream_mode() -> None:
         poison_rate=poison, seed=seed,
         key_pool=4096 if tpu else 32,
         time_scale=1.0 / max(overload, 1e-6),
+        slashing_flood_rate=slashing, reorg_storm=reorg,
+        non_finality_epochs=nonfinal, sync_period_boundary=sync_period,
     )
 
     sched_overrides = {}
     if not wall:
         # Calibrate modeled per-chunk occupancy so service capacity
         # equals the UNSCALED arrival rate: BENCH_OVERLOAD then means
-        # "arrivals outpace the device by exactly this factor".
-        events_per_epoch = slots * (
-            committees + unagg + sync + (1 if traffic_cfg.blocks else 0)
-        )
+        # "arrivals outpace the device by exactly this factor". Count
+        # the real generated stream — the weather axes make the
+        # closed-form slot arithmetic undercount.
+        events_per_epoch = len(TrafficGenerator(traffic_cfg).generate())
         base_rate = events_per_epoch / max(slots * sps, 1e-9)
         sched_cfg_probe = SchedulerConfig.from_env()
         quantum = max(1, sched_cfg_probe.batch_target // 4)
@@ -632,14 +650,23 @@ def stream_mode() -> None:
             "clean_digest": clean["stream"]["verdict_digest"],
             "digests_match": (report["stream"]["verdict_digest"]
                               == clean["stream"]["verdict_digest"]),
+            # slasher findings are part of the parity contract: a fault
+            # may change HOW votes were scanned, never WHAT was found
+            "slasher_digests_match": (
+                report["sched"]["slasher"]["findings_digest"]
+                == clean["sched"]["slasher"]["findings_digest"]
+            ),
         }
 
     served = report["events_served"]
     block = report["sched"]["block"]
+    scenarios = report["scenarios"]
     ok = (report["verdicts"]["mismatches"] == 0 and served > 0
           and block["shed"] == 0 and block["dropped"] == 0
           and report["accounting"]["balanced"]
-          and (replay is None or replay["digests_match"]))
+          and scenarios["ok"]
+          and (replay is None or (replay["digests_match"]
+                                  and replay["slasher_digests_match"])))
     print(json.dumps({
         "metric": "stream_sets_per_sec",
         "value": round(served / wall_s, 2) if ok else 0.0,
@@ -651,6 +678,12 @@ def stream_mode() -> None:
             "unaggregated_per_slot": unagg, "sync_per_slot": sync,
             "seconds_per_slot": sps, "overload": overload,
             "poison_rate": poison, "seed": seed,
+            "weather": {
+                "slashing_flood_rate": slashing, "reorg_storm": reorg,
+                "non_finality_epochs": nonfinal,
+                "sync_period_boundary": sync_period,
+            },
+            "scenarios": scenarios,
             "clock": "wall" if wall else "virtual",
             "events": report["stream"]["events"],
             "events_served": served,

@@ -292,6 +292,56 @@ class SloBreachSentinel(Sentinel):
         return HEALTHY, detail
 
 
+class QueuePressureSentinel(Sentinel):
+    """Scheduler queue depth held near its cap (ISSUE 17): a
+    non-finality stall keeps fork-choice fan-out arriving faster than
+    it drains, so depth pins at the cap for epochs — pressure the RSS
+    sentinel only sees much later. ``streak`` consecutive pressured
+    checks (any class's depth ≥ ``high_frac`` × queue cap) is degraded,
+    ``2*streak`` critical; any relief resets the streak."""
+
+    name = "queue_pressure"
+
+    def __init__(self, high_frac: float | None = None,
+                 streak: int | None = None, depths_fn=None):
+        self.high_frac = (knob("LHTPU_QUEUE_HIGH_FRAC")
+                          if high_frac is None else high_frac)
+        self.streak = (knob("LHTPU_QUEUE_STREAK")
+                       if streak is None else streak)
+        self.cap = int(knob("LHTPU_SCHED_QUEUE_CAP"))
+        self._depths = depths_fn if depths_fn is not None else self._gauge
+        self.current = 0
+
+    @staticmethod
+    def _gauge() -> list[tuple[dict, float]]:
+        from ..loadgen import slo
+
+        return slo.SCHED_QUEUE_DEPTH.items()
+
+    def check(self, now: float) -> tuple[int, dict]:
+        threshold = self.high_frac * self.cap
+        deep = {
+            labels.get("work_class", "?"): depth
+            for labels, depth in self._depths()
+            if depth >= threshold
+        }
+        if deep:
+            self.current += 1
+        else:
+            self.current = 0
+        detail = {
+            "pressured_classes": deep,
+            "threshold": threshold,
+            "pressure_streak": self.current,
+            "streak_budget": self.streak,
+        }
+        if self.current >= 2 * self.streak:
+            return CRITICAL, detail
+        if self.current >= self.streak:
+            return DEGRADED, detail
+        return HEALTHY, detail
+
+
 def default_sentinels() -> list[Sentinel]:
     return [
         RssGrowthSentinel(),
@@ -299,6 +349,7 @@ def default_sentinels() -> list[Sentinel]:
         CacheHitRateSentinel(),
         BreakerFlapSentinel(),
         SloBreachSentinel(),
+        QueuePressureSentinel(),
     ]
 
 

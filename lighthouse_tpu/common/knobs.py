@@ -162,6 +162,12 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_SLO_BREACH_STREAK", "int", 3,
          "Consecutive p99-over-budget reports that report degraded (2x = critical)",
          "lighthouse_tpu/common/health.py"),
+    Knob("LHTPU_QUEUE_HIGH_FRAC", "float", 0.85,
+         "Scheduler queue depth fraction of LHTPU_SCHED_QUEUE_CAP that counts as pressured",
+         "lighthouse_tpu/common/health.py"),
+    Knob("LHTPU_QUEUE_STREAK", "int", 3,
+         "Consecutive pressured checks that report degraded (2x = critical)",
+         "lighthouse_tpu/common/health.py"),
     # -------------------------------------------- parallel/engine.py
     Knob("LHTPU_DEVICES", "optint", None,
          "Cap on mesh device count; unset = every visible device (pow2-floored)",
@@ -258,6 +264,25 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_SCHED_CACHE_CAP", "int", 4096,
          "Composition-cache entry capacity (LRU beyond it)",
          "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_SLASHING_DEADLINE_MS", "float", 50.0,
+         "Slashing-class coalescing deadline before a partial batch fires",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_STARVATION_MS", "float", 1000.0,
+         "Oldest-event wait past which a non-block class outranks priority order (0 disables)",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    Knob("LHTPU_SCHED_SLASHER", "bool", True,
+         "Feed slashing-event votes through the surround/double-vote slasher sink",
+         "lighthouse_tpu/loadgen/scheduler.py"),
+    # ---------------------------------------------------- slasher/arrays.py
+    Knob("LHTPU_SLASHER_DEVICE", "optstr", None,
+         "Force the device slasher planes on (1) / off (0); unset = on when jax imports",
+         "lighthouse_tpu/slasher/arrays.py"),
+    Knob("LHTPU_SLASHER_CHUNK", "int", 256,
+         "Validators per device slasher plane chunk",
+         "lighthouse_tpu/slasher/arrays.py"),
+    Knob("LHTPU_SLASHER_HISTORY", "int", 4096,
+         "Epoch ring length of the device slasher min/max-target planes",
+         "lighthouse_tpu/slasher/arrays.py"),
     # ------------------------------------------------- loadgen/soak.py
     Knob("LHTPU_CHAOS_SCHEDULE", "str", "",
          "Soak chaos plan: epoch:stage:kind:count[;...] layered on the fault injector",
@@ -270,6 +295,9 @@ _ALL: tuple[Knob, ...] = (
          "lighthouse_tpu/loadgen/soak.py"),
     Knob("LHTPU_SOAK_WATCHDOG_MIN_S", "float", 300.0,
          "Epoch watchdog budget floor in seconds (must clear a cold XLA compile)",
+         "lighthouse_tpu/loadgen/soak.py"),
+    Knob("LHTPU_WEATHER_SCHEDULE", "str", "",
+         "Chain-weather plan: epoch:axis:value[;...] over the traffic weather axes",
          "lighthouse_tpu/loadgen/soak.py"),
 )
 

@@ -35,6 +35,9 @@ CANONICAL_STAGES: tuple[str, ...] = (
     "bench_device",     # bench.py's forced device probe dispatches
     # Host-side scheduler stages (loadgen/scheduler.py).
     "sched_cache",      # cross-slot committee-composition pubkey cache
+    # Device slasher (slasher/arrays.py SurroundEngine): batched
+    # surround/double-vote plane updates; degrades to the host path.
+    "slasher",
 )
 
 _STAGE_SET = frozenset(CANONICAL_STAGES)

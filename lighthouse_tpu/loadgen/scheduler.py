@@ -58,22 +58,29 @@ from ..network.processor import (
 )
 from . import slo
 from .serve import VirtualClock, WallClock, verdict_digest
-from .soak import chaos_spec_for_epoch, parse_chaos_schedule
+from .soak import (
+    chaos_spec_for_epoch, parse_chaos_schedule, parse_weather_schedule,
+    weather_for_epoch,
+)
 from .traffic import TimedEvent, TrafficConfig, TrafficGenerator
 
 __all__ = [
     "SchedulerConfig", "StreamScheduler", "StreamRunner",
-    "CompositionCache", "continuous_digest",
+    "CompositionCache", "continuous_digest", "scenario_slo",
 ]
 
 #: classes that may be shed (priority order: SYNC sheds first). BLOCK is
 #: chain liveness — never shed, never dropped by admission.
 _SHEDDABLE_CLASSES = (
-    WorkClass.AGGREGATE, WorkClass.ATTESTATION, WorkClass.SYNC,
+    WorkClass.SLASHING, WorkClass.AGGREGATE, WorkClass.ATTESTATION,
+    WorkClass.SYNC,
 )
 #: fraction of the class queue cap at which each class's shed watermark
 #: sits while HEALTHY — lower classes shed earlier by construction.
+#: SLASHING sits just below AGGREGATE: whistleblower evidence is worth
+#: keeping under pressure, but never at the cost of chain liveness.
 _CLASS_WATERMARK = {
+    WorkClass.SLASHING: 0.60,
     WorkClass.AGGREGATE: 0.75,
     WorkClass.ATTESTATION: 0.50,
     WorkClass.SYNC: 0.25,
@@ -85,6 +92,7 @@ class SchedulerConfig:
     batch_target: int = 256        # full-batch dispatch size per class
     # per-class coalescing deadlines (ms); block=0 → immediate dispatch
     block_deadline_ms: float = 0.0
+    slashing_deadline_ms: float = 50.0
     agg_deadline_ms: float = 100.0
     att_deadline_ms: float = 250.0
     sync_deadline_ms: float = 500.0
@@ -94,10 +102,16 @@ class SchedulerConfig:
     cache: bool = True             # cross-slot composition cache
     cache_cap: int = 4096
     slo_budget_ms: float = 4000.0  # p99 budget (block class is the headline)
+    # anti-starvation: oldest-event wait past which a non-block class
+    # outranks strict priority order (slashing floods must not starve
+    # attestations); 0 disables the guard
+    starvation_ms: float = 1000.0
+    slasher: bool = True           # feed slashing votes to the device slasher
 
     def deadline_ms(self, cls: WorkClass) -> float:
         return {
             WorkClass.BLOCK: self.block_deadline_ms,
+            WorkClass.SLASHING: self.slashing_deadline_ms,
             WorkClass.AGGREGATE: self.agg_deadline_ms,
             WorkClass.ATTESTATION: self.att_deadline_ms,
             WorkClass.SYNC: self.sync_deadline_ms,
@@ -111,6 +125,8 @@ class SchedulerConfig:
         cfg = {
             "batch_target": int(knobs.knob("LHTPU_BATCH_TARGET")),
             "block_deadline_ms": knobs.knob("LHTPU_SCHED_BLOCK_DEADLINE_MS"),
+            "slashing_deadline_ms": knobs.knob(
+                "LHTPU_SCHED_SLASHING_DEADLINE_MS"),
             "agg_deadline_ms": knobs.knob("LHTPU_SCHED_AGG_DEADLINE_MS"),
             "att_deadline_ms": knobs.knob("LHTPU_SCHED_ATT_DEADLINE_MS"),
             "sync_deadline_ms": knobs.knob("LHTPU_SCHED_SYNC_DEADLINE_MS"),
@@ -120,6 +136,8 @@ class SchedulerConfig:
             "cache": bool(knobs.knob("LHTPU_SCHED_CACHE")),
             "cache_cap": int(knobs.knob("LHTPU_SCHED_CACHE_CAP")),
             "slo_budget_ms": knobs.knob("LHTPU_SLO_BUDGET_MS"),
+            "starvation_ms": knobs.knob("LHTPU_SCHED_STARVATION_MS"),
+            "slasher": bool(knobs.knob("LHTPU_SCHED_SLASHER")),
         }
         cfg.update(overrides)
         return cls(**cfg)
@@ -272,6 +290,73 @@ class CompositionCache:
         }
 
 
+# ------------------------------------------------------------ slasher sink
+
+class _SlasherSink:
+    """Feeds slashing-flood attestation votes through the
+    SurroundEngine device planes and confirms double-vote candidates
+    against an exact-target root map (the same two-step the
+    DeviceSlasher does against its KV store, collapsed to the loadgen
+    payload's ``(validator, source, target, root_tag)`` tuples).
+
+    Findings fold into a running sha256 — the digest is the
+    fault-drill's evidence that a ``slasher``-stage fault degraded to
+    the host path *without losing findings*: a degraded run must match
+    the clean run's digest bit-for-bit."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self.engine = None            # lazy: first votes build it
+        self.votes = 0
+        self.events = 0
+        self.findings = 0
+        self.by_kind: dict[str, int] = {}
+        self._roots: dict[tuple[int, int], int] = {}
+        self._h = hashlib.sha256()
+
+    def ingest(self, payload) -> None:
+        votes = getattr(payload, "votes", ())
+        if not self.enabled or not votes:
+            return
+        if self.engine is None:
+            from ..slasher.arrays import SurroundEngine
+
+            self.engine = SurroundEngine()
+        from ..slasher.arrays import (
+            CODE_DOUBLE, CODE_SURROUNDED, CODE_SURROUNDS,
+        )
+
+        self.events += 1
+        codes = self.engine.process([(v, s, t) for v, s, t, _ in votes])
+        for (v, s, t, root), code in zip(votes, codes):
+            self.votes += 1
+            prev = self._roots.get((v, t))
+            found = []
+            if code & CODE_DOUBLE and prev is not None and prev != root:
+                found.append("double")
+            if code & CODE_SURROUNDED:
+                found.append("surrounded")
+            elif code & CODE_SURROUNDS:
+                found.append("surrounds")
+            for kind in found:
+                self.findings += 1
+                self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+                self._h.update(f"{kind}|{v}|{s}|{t}|{root}|".encode())
+            if prev is None:
+                self._roots[(v, t)] = root
+
+    def report(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "events": self.events,
+            "votes": self.votes,
+            "findings": self.findings,
+            "by_kind": dict(self.by_kind),
+            "findings_digest": self._h.hexdigest(),
+            "engine": self.engine.report() if self.engine else None,
+        }
+
+
 # -------------------------------------------------------------- scheduler
 
 class StreamScheduler:
@@ -290,6 +375,7 @@ class StreamScheduler:
         self.cache = CompositionCache(
             cap=self.cfg.cache_cap, enabled=self.cfg.cache
         )
+        self.slasher = _SlasherSink(enabled=self.cfg.slasher)
         block_cap = max(self.cfg.queue_cap, 65536)  # blocks must not drop
         self.lanes: dict[WorkClass, _Lanes] = {
             cls: _Lanes(cap=block_cap if cls is WorkClass.BLOCK
@@ -308,6 +394,7 @@ class StreamScheduler:
         self.preempted_batches = 0
         self.preempted_by_class: dict[str, int] = {}
         self.requeued_by_class: dict[str, int] = {}
+        self.starvation_rescues: dict[str, int] = {}
         self.batches = 0
         self._pending: deque[tuple[float, WorkEvent]] = deque()
 
@@ -420,6 +507,8 @@ class StreamScheduler:
             self.recorder.observe(wt, max(0.0, t1 - t0))
             c = work_class(ev.work_type).value
             self.served_by_class[c] = self.served_by_class.get(c, 0) + 1
+            if bool(ok):  # only verified slashing evidence is ingested
+                self.slasher.ingest(p)
 
     def _dispatch_batch(self, cls: WorkClass,
                         items: list[tuple[float, WorkEvent]]) -> None:
@@ -465,19 +554,51 @@ class StreamScheduler:
                 return
 
     def _dispatch_due_once(self) -> bool:
-        """One scheduling decision: blocks first, then the highest
-        priority class that is due. Returns True if work dispatched."""
+        """One scheduling decision: blocks first, then a starvation
+        rescue if any non-block class has waited past the guard, then
+        the highest priority class that is due. Returns True if work
+        dispatched."""
         if self.lanes[WorkClass.BLOCK].depth > 0 \
                 and self._due(WorkClass.BLOCK):
             self._dispatch_batch(
                 WorkClass.BLOCK, self._form(WorkClass.BLOCK)
             )
             return True
+        rescued = self._starvation_rescue()
+        if rescued is not None:
+            self._dispatch_batch(rescued, self._form(rescued))
+            return True
         for cls in CLASS_PRIORITY[1:]:
             if self._due(cls):
                 self._dispatch_batch(cls, self._form(cls))
                 return True
         return False
+
+    def _starvation_rescue(self) -> WorkClass | None:
+        """Under a sustained flood, a higher class can be due on every
+        decision and classes below it never fire. When the oldest event
+        of any non-block class has waited past ``starvation_ms``, the
+        most-overdue such class outranks strict priority order —
+        "slashing flood must not starve attestations" as mechanism."""
+        if self.cfg.starvation_ms <= 0:
+            return None
+        worst: tuple[float, int] | None = None
+        now = self.clock.now()
+        for idx, cls in enumerate(CLASS_PRIORITY[1:]):
+            lanes = self.lanes[cls]
+            if lanes.depth == 0:
+                continue
+            waited_ms = (now - lanes.oldest_t()) * 1e3
+            if waited_ms < self.cfg.starvation_ms:
+                continue
+            if worst is None or waited_ms > worst[0]:
+                worst = (waited_ms, idx)
+        if worst is None:
+            return None
+        cls = CLASS_PRIORITY[1:][worst[1]]
+        c = cls.value
+        self.starvation_rescues[c] = self.starvation_rescues.get(c, 0) + 1
+        return cls
 
     # -------------------------------------------------------------- drive
     def _feed_due(self) -> None:
@@ -594,6 +715,8 @@ class StreamScheduler:
                 "requeued_by_class": dict(self.requeued_by_class),
                 "batches": self.batches,
                 "cache": self.cache.report(),
+                "slasher": self.slasher.report(),
+                "starvation_rescues": dict(self.starvation_rescues),
                 "tenants_shed": len(self.shed_by_tenant),
                 "block": {
                     "shed": self.shed_by_class.get(
@@ -635,6 +758,63 @@ def continuous_digest(verdicts: dict[int, bool]) -> str:
     return verdict_digest(verdicts)
 
 
+def scenario_slo(report: dict, traffic: TrafficConfig) -> dict:
+    """Per-scenario SLO verdicts for whichever chain-weather axes the
+    traffic config enables — the asserted acceptance lines ("slashing
+    flood must not starve attestations, and blocks are never shed"),
+    not folklore. Returns ``{"ok": all_pass, "scenarios": {...}}``;
+    with no axis enabled the verdict is vacuously ok."""
+    per_class = report["slo"]["per_class"]
+    blk = report["sched"]["block"]
+    acct = report["accounting"]
+    scenarios: dict[str, dict] = {}
+    if traffic.slashing_flood_rate > 0:
+        att = per_class[WorkClass.ATTESTATION.value]
+        sl = per_class[WorkClass.SLASHING.value]
+        scenarios["slashing_flood"] = {
+            "ok": bool(
+                blk["shed"] == 0 and blk["dropped"] == 0
+                and att["served"] > 0 and sl["served"] > 0
+            ),
+            "blocks_shed": blk["shed"],
+            "blocks_dropped": blk["dropped"],
+            "attestations_served": att["served"],
+            "attestation_p99_ms": att["p99_ms"],
+            "slashing_served": sl["served"],
+            "slasher_findings": report["sched"]["slasher"]["findings"],
+        }
+    if traffic.reorg_storm > 0:
+        b = per_class[WorkClass.BLOCK.value]
+        scenarios["reorg_storm"] = {
+            "ok": bool(
+                blk["shed"] == 0 and blk["dropped"] == 0
+                and b["served"] > 0 and blk["within_budget"]
+            ),
+            "blocks_served": b["served"],
+            "block_p99_ms": blk["p99_ms"],
+        }
+    if traffic.non_finality_epochs > 0:
+        scenarios["non_finality"] = {
+            "ok": bool(
+                acct["balanced"] and acct["pending"] == 0
+                and blk["shed"] == 0 and blk["dropped"] == 0
+            ),
+            "pending": acct["pending"],
+            "shed": acct["shed"],
+        }
+    if traffic.sync_period_boundary > 0:
+        sy = per_class[WorkClass.SYNC.value]
+        scenarios["sync_boundary"] = {
+            "ok": bool(sy["served"] > 0),
+            "sync_served": sy["served"],
+            "sync_p99_ms": sy["p99_ms"],
+        }
+    return {
+        "ok": all(s["ok"] for s in scenarios.values()),
+        "scenarios": scenarios,
+    }
+
+
 class StreamRunner:
     """Multi-epoch continuous driver: one StreamScheduler fed epoch
     streams back-to-back on one clock, so queues and the composition
@@ -652,7 +832,8 @@ class StreamRunner:
     def __init__(self, traffic: TrafficConfig, epochs: int,
                  config: SchedulerConfig | None = None, *,
                  clock=None, backend: str | None = None, verify=None,
-                 chaos: str | None = None, emit=None):
+                 chaos: str | None = None, emit=None,
+                 weather: str | None = None):
         self.traffic = traffic
         self.epochs = max(1, int(epochs))
         self.cfg = config or SchedulerConfig()
@@ -662,13 +843,37 @@ class StreamRunner:
         self.chaos = parse_chaos_schedule(
             knobs.knob("LHTPU_CHAOS_SCHEDULE") if chaos is None else chaos
         )
+        # Weather is TRAFFIC, not faults: a chaos-free replay must keep
+        # the same weather plan or the streams (and digests) diverge.
+        self.weather = parse_weather_schedule(
+            knobs.knob("LHTPU_WEATHER_SCHEDULE") if weather is None
+            else weather
+        )
         self.emit = emit
+        # widest weather seen across epochs, for scenario scoring
+        self._axes = replace(traffic)
 
-    def _epoch_events(self, epoch: int) -> list[TimedEvent]:
+    def _epoch_traffic(self, epoch: int) -> TrafficConfig:
         cfg = replace(
             self.traffic, seed=self.traffic.seed + self.SEED_STRIDE * epoch
         )
-        events = TrafficGenerator(cfg).generate()
+        over = weather_for_epoch(self.weather, epoch)
+        if over:
+            cfg = replace(cfg, **over)
+        self._axes = replace(
+            self._axes,
+            reorg_storm=max(self._axes.reorg_storm, cfg.reorg_storm),
+            non_finality_epochs=max(
+                self._axes.non_finality_epochs, cfg.non_finality_epochs),
+            slashing_flood_rate=max(
+                self._axes.slashing_flood_rate, cfg.slashing_flood_rate),
+            sync_period_boundary=max(
+                self._axes.sync_period_boundary, cfg.sync_period_boundary),
+        )
+        return cfg
+
+    def _epoch_events(self, epoch: int) -> list[TimedEvent]:
+        events = TrafficGenerator(self._epoch_traffic(epoch)).generate()
         for te in events:
             te.payload.seq += self.SEQ_STRIDE * epoch
         return events
@@ -717,5 +922,7 @@ class StreamRunner:
             "rows": rows,
             "verdict_digest": verdict_digest(sched.verdicts),
             "chaos": bool(self.chaos),
+            "weather": bool(self.weather),
         }
+        report["scenarios"] = scenario_slo(report, self._axes)
         return report

@@ -42,15 +42,24 @@ from . import slo
 from .traffic import TimedEvent
 
 # Work that may be rejected under backpressure. Blocks (gossip or RPC)
-# are chain liveness — never shed.
+# are chain liveness — never shed. Slashing evidence (ISSUE 17) is
+# sheddable in principle, but sits on its own block-adjacent lane in
+# the stream scheduler so floods shed the low classes first.
 SHEDDABLE = {
     WorkType.GOSSIP_ATTESTATION,
     WorkType.GOSSIP_AGGREGATE,
     WorkType.GOSSIP_SYNC_SIGNATURE,
+    WorkType.GOSSIP_ATTESTER_SLASHING,
+    WorkType.GOSSIP_PROPOSER_SLASHING,
 }
 
 # Default handlers verify these work types as signature sets.
-_SINGLE_VERIFIED = (WorkType.GOSSIP_SYNC_SIGNATURE, WorkType.GOSSIP_BLOCK)
+_SINGLE_VERIFIED = (
+    WorkType.GOSSIP_SYNC_SIGNATURE,
+    WorkType.GOSSIP_BLOCK,
+    WorkType.GOSSIP_ATTESTER_SLASHING,
+    WorkType.GOSSIP_PROPOSER_SLASHING,
+)
 
 
 class WallClock:

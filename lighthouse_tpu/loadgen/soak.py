@@ -99,6 +99,61 @@ def chaos_spec_for_epoch(schedule: list[ChaosEvent], epoch: int) -> str:
     )
 
 
+# ------------------------------------------------------------ chain weather
+
+#: weather-plan axis names → (TrafficConfig field, value parser)
+_WEATHER_AXES = {
+    "reorg_storm": ("reorg_storm", float),
+    "non_finality": ("non_finality_epochs", int),
+    "slashing_flood": ("slashing_flood_rate", float),
+    "sync_boundary": ("sync_period_boundary", int),
+}
+
+
+@dataclass
+class WeatherEvent:
+    epoch: int | None      # None = every epoch (the '*' wildcard)
+    field: str             # TrafficConfig field name
+    value: float | int
+
+
+def parse_weather_schedule(spec: str | None) -> list[WeatherEvent]:
+    """``"<epoch>:<axis>:<value>;..."`` → weather events; ``*`` as the
+    epoch applies the axis to every epoch. Axes are the chain-weather
+    names (``reorg_storm`` / ``non_finality`` / ``slashing_flood`` /
+    ``sync_boundary``). Weather is TRAFFIC, not faults — it rides the
+    TrafficConfig (so chaos-free replays keep it and digests stay
+    comparable), never LHTPU_FAULT_INJECT. Malformed items are warned
+    and skipped, same forgiveness as the chaos grammar."""
+    out: list[WeatherEvent] = []
+    for item in filter(None, (p.strip() for p in (spec or "").split(";"))):
+        try:
+            epoch_s, axis, value_s = item.split(":")
+            fld, cast = _WEATHER_AXES[axis]
+            out.append(WeatherEvent(
+                epoch=None if epoch_s == "*" else int(epoch_s),
+                field=fld, value=cast(value_s),
+            ))
+        except (ValueError, KeyError):
+            print(
+                f"soak: ignoring malformed LHTPU_WEATHER_SCHEDULE item "
+                f"{item!r} (want epoch:axis:value, axis one of "
+                f"{sorted(_WEATHER_AXES)})",
+                file=sys.stderr,
+            )
+    return out
+
+
+def weather_for_epoch(schedule: list[WeatherEvent],
+                      epoch: int) -> dict[str, float | int]:
+    """TrafficConfig overrides for one epoch (later items win)."""
+    out: dict[str, float | int] = {}
+    for ev in schedule:
+        if ev.epoch is None or ev.epoch == epoch:
+            out[ev.field] = ev.value
+    return out
+
+
 def _primary_rung() -> str:
     """The ladder's top rung on THIS host (fused only when the fused
     path is actually the configured primary — off-TPU it is classic)."""
@@ -140,6 +195,10 @@ class SoakConfig:
     watchdog_k: float | None = None   # None = LHTPU_SOAK_WATCHDOG_K (20)
     watchdog_min_s: float | None = None  # None = ..._MIN_S (300)
     replay: bool = True               # chaos-free digest-parity replay
+    # chain-weather plan ("epoch:axis:value;..."); None = the
+    # LHTPU_WEATHER_SCHEDULE knob. Weather survives the replay pass —
+    # it is part of the traffic, not of the chaos.
+    weather: str | None = None
 
     def __post_init__(self):
         if self.leak_mb is None:
@@ -163,6 +222,10 @@ class SoakRunner:
         self.cfg = cfg
         self.chaos = list(chaos) if chaos is not None else (
             parse_chaos_schedule(knobs.knob("LHTPU_CHAOS_SCHEDULE"))
+        )
+        self.weather = parse_weather_schedule(
+            knobs.knob("LHTPU_WEATHER_SCHEDULE") if cfg.weather is None
+            else cfg.weather
         )
         self.emit = emit
 
@@ -194,6 +257,9 @@ class SoakRunner:
         traffic_cfg = replace(
             cfg.traffic, seed=cfg.seed + _SEED_STRIDE * epoch
         )
+        over = weather_for_epoch(self.weather, epoch)
+        if over:
+            traffic_cfg = replace(traffic_cfg, **over)
         events = TrafficGenerator(traffic_cfg).generate()
         loop = ServingLoop(
             cfg.serve or ServeConfig.from_env(),
@@ -410,6 +476,10 @@ class SoakRunner:
             "digest": combined,
             "chaos_schedule": ";".join(
                 f"{e.epoch}:{e.stage}:{e.kind}:{e.count}" for e in self.chaos
+            ),
+            "weather_schedule": ";".join(
+                f"{'*' if e.epoch is None else e.epoch}:{e.field}:{e.value}"
+                for e in self.weather
             ),
             "seed": cfg.seed,
             "replay": {"ran": False, "digests_match": None},

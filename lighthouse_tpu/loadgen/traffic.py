@@ -21,6 +21,26 @@ a 1M-validator mainnet node sees on gossip each slot:
   message dedup;
 * **skipped slots** — no block event that slot.
 
+ISSUE 17 adds four *chain-weather* axes on top (each seeded,
+digest-stable, and composable with the above — a disabled axis draws
+NOTHING from the rng, so existing streams stay bit-identical):
+
+* **reorg storms** (``reorg_storm``) — per-slot probability of a burst
+  of competing-head blocks (forked, never sheddable) plus a
+  re-dispatched aggregate wave voting the competing head;
+* **non-finality** (``non_finality_epochs``) — finality stalled for N
+  epochs: every committee re-votes up to ``min(N, 4)`` extra
+  fork-variant heads per slot, inflating fork-choice fan-out and
+  holding queue depth high (the health governor's pressure scenario);
+* **slashing floods** (``slashing_flood_rate``) — waves of
+  AttesterSlashing/ProposerSlashing work riding the block-adjacent
+  SLASHING lane; attester events carry ``votes`` tuples
+  ``(validator, source, target, root_tag)`` forming real
+  double/surround pairs the device slasher can detect;
+* **sync period boundaries** (``sync_period_boundary``) — committee
+  rotation spikes: at each period edge a burst of sync signatures with
+  fresh membership and fresh messages.
+
 Everything is driven by one ``random.Random(seed)``: the same seed
 reproduces the identical stream bit-for-bit (``stream_digest`` proves
 it), which the bench's determinism acceptance and the oracle-parity
@@ -61,6 +81,10 @@ class LoadPayload:
     message: bytes
     members: tuple[int, ...]  # key-pool indices behind the signature
     forked: bool = False
+    # Slashing-flood only: (validator, source_epoch, target_epoch,
+    # root_tag) tuples the scheduler's slasher sink replays as
+    # attestation history. Empty for every other kind.
+    votes: tuple[tuple[int, int, int, int], ...] = ()
 
 
 @dataclass
@@ -82,7 +106,9 @@ class TrafficConfig:
     committees_per_slot: int | None = None
     committee_size: int | None = None
     unaggregated_per_slot: int = 64   # subnet-sampled single attestations
-    sync_per_slot: int = 0            # sync-committee signatures
+    # None = derive a spec-shaped sync load from the resolved committee
+    # shape (see resolved_sync_per_slot); 0 disables the SYNC lane.
+    sync_per_slot: int | None = None
     blocks: bool = True
     block_delay_s: float | None = None  # None = SPS/6 into the slot
     burstiness: float = 0.8           # fraction arriving in the burst window
@@ -90,6 +116,12 @@ class TrafficConfig:
     poison_rate: float = 0.0
     fork_churn_rate: float = 0.0
     skip_slot_prob: float = 0.0
+    # Chain-weather axes (ISSUE 17). Each disabled axis draws nothing
+    # from the rng, so enabling one never perturbs the others' streams.
+    reorg_storm: float = 0.0          # P(slot sees a competing-head burst)
+    non_finality_epochs: int = 0      # finality stall depth (fan-out cap 4)
+    slashing_flood_rate: float = 0.0  # slashing events per committee-slot
+    sync_period_boundary: int = 0     # slots per sync period (0 = off)
     key_pool: int = 64                # sequential-key fixture pool size
     peers: int = 16                   # distinct tenant (peer) identities
     seed: int = 1234
@@ -105,6 +137,17 @@ class TrafficConfig:
         if self.committee_size is not None:
             size = self.committee_size
         return committees, size
+
+    def resolved_sync_per_slot(self) -> int:
+        """Spec-shaped SYNC lane default: the sync committee is 512
+        validators signing once per slot, so scale the per-slot load
+        with the attestation shape (committees x size / 64) and cap at
+        the spec's 512 — ~488 at mainnet 1M-validator shape, >=1 for
+        tiny test shapes. An explicit ``sync_per_slot`` wins."""
+        if self.sync_per_slot is not None:
+            return self.sync_per_slot
+        committees, size = self.resolved_shape()
+        return max(1, min(512, (committees * size) // 64))
 
 
 def _msg32(tag: str) -> bytes:
@@ -163,6 +206,7 @@ class TrafficGenerator:
         cfg = self.cfg
         rng = random.Random(cfg.seed)
         n_comm, comm_size = cfg.resolved_shape()
+        sync_n = cfg.resolved_sync_per_slot()
         pool = len(self._pool)
         sps = cfg.seconds_per_slot
         phase = sps / 3.0
@@ -175,13 +219,14 @@ class TrafficGenerator:
 
         def emit(t: float, wt: WorkType, kind: str, slot: int,
                  members: tuple[int, ...], msg: bytes,
-                 poisoned: bool, forked: bool = False) -> None:
+                 poisoned: bool, forked: bool = False,
+                 votes: tuple[tuple[int, int, int, int], ...] = ()) -> None:
             nonlocal seq
             payload = LoadPayload(
                 seq=seq, kind=kind, slot=slot,
                 sig_set=self._sig_set(members, msg, poisoned),
                 expected=not poisoned, message=msg, members=members,
-                forked=forked,
+                forked=forked, votes=votes,
             )
             raw.append((t, seq, wt, payload))
             seq += 1
@@ -225,7 +270,7 @@ class TrafficGenerator:
                     forked=forked,
                 )
 
-            for j in range(cfg.sync_per_slot):
+            for j in range(sync_n):
                 member = (s * 13 + j * 3 + 1) % pool
                 emit(
                     self._arrival(rng, att_open, phase),
@@ -246,6 +291,107 @@ class TrafficGenerator:
                     members, msg, rng.random() < cfg.poison_rate,
                     forked=forked,
                 )
+
+            # ---- chain weather (ISSUE 17) -------------------------
+            # Fixed axis order; every axis is gated BEFORE its first
+            # rng draw so a disabled axis leaves the stream above (and
+            # its digest) bit-identical.
+            if cfg.reorg_storm > 0.0 and rng.random() < cfg.reorg_storm:
+                # Burst of competing-head blocks (forked, never
+                # sheddable) followed by a re-dispatched aggregate wave
+                # voting the competing head: same committees (the
+                # composition cache should absorb the re-dispatch) but
+                # a fork-variant message that defeats message dedup.
+                heads = 1 + rng.randrange(2)
+                for k in range(heads):
+                    proposer = (s * 31 + 7 * (k + 1)) % pool
+                    emit(
+                        base + block_delay + (k + 1) * 0.05
+                        + rng.random() * 0.05,
+                        WorkType.GOSSIP_BLOCK, "block", s, (proposer,),
+                        _msg32(f"lhtpu-block|{s}|reorg|{k}"),
+                        rng.random() < cfg.poison_rate, forked=True,
+                    )
+                for ci in range(n_comm):
+                    start = (s * n_comm + ci) * comm_size
+                    members = tuple(
+                        (start + j) % pool for j in range(comm_size)
+                    )
+                    emit(
+                        self._arrival(rng, agg_open, phase),
+                        WorkType.GOSSIP_AGGREGATE, "aggregate", s,
+                        members, _msg32(f"lhtpu-att|{s}|{ci}|reorg"),
+                        rng.random() < cfg.poison_rate, forked=True,
+                    )
+
+            if cfg.non_finality_epochs > 0:
+                # Finality stalled: fork choice fans out and every
+                # committee re-votes extra candidate heads each slot,
+                # holding queue depth high for the stall's duration.
+                fanout = min(cfg.non_finality_epochs, 4)
+                for k in range(fanout):
+                    for ci in range(n_comm):
+                        start = (s * n_comm + ci) * comm_size
+                        members = tuple(
+                            (start + j) % pool for j in range(comm_size)
+                        )
+                        emit(
+                            self._arrival(rng, agg_open, phase),
+                            WorkType.GOSSIP_AGGREGATE, "aggregate", s,
+                            members, _msg32(f"lhtpu-att|{s}|{ci}|nf{k}"),
+                            rng.random() < cfg.poison_rate, forked=True,
+                        )
+
+            if cfg.slashing_flood_rate > 0.0:
+                n_slash = int(round(cfg.slashing_flood_rate * n_comm))
+                for k in range(n_slash):
+                    arrival = self._arrival(rng, base + block_delay, phase)
+                    if k % 3 == 2:
+                        # proposer double-proposal: header-level, no
+                        # attestation votes for the slasher sink
+                        proposer = (s * 31 + k) % pool
+                        emit(
+                            arrival, WorkType.GOSSIP_PROPOSER_SLASHING,
+                            "proposer_slashing", s, (proposer,),
+                            _msg32(f"lhtpu-slash|prop|{s}|{k}"),
+                            rng.random() < cfg.poison_rate,
+                        )
+                        continue
+                    # Attester slashing: a vote pair over a small
+                    # validator space so histories interact across
+                    # events — double votes, surrounds, and clean pairs
+                    # the device slasher must classify exactly.
+                    v = rng.randrange(max(8, min(cfg.validators, 512)))
+                    e0 = 2 + rng.randrange(48)
+                    shape = rng.random()
+                    if shape < 0.4:    # same target, different roots
+                        votes = ((v, e0, e0 + 2, 0), (v, e0 + 1, e0 + 2, 1))
+                    elif shape < 0.8:  # second vote surrounds the first
+                        votes = ((v, e0 + 1, e0 + 2, 0), (v, e0, e0 + 3, 1))
+                    else:              # clean adjacent pair
+                        votes = ((v, e0, e0 + 1, 0), (v, e0 + 1, e0 + 2, 0))
+                    emit(
+                        arrival, WorkType.GOSSIP_ATTESTER_SLASHING,
+                        "attester_slashing", s, (v % pool,),
+                        _msg32(f"lhtpu-slash|att|{s}|{k}"),
+                        rng.random() < cfg.poison_rate, votes=votes,
+                    )
+
+            if cfg.sync_period_boundary > 0 and (
+                s % cfg.sync_period_boundary == 0
+            ):
+                # Sync-committee rotation: the new period's committee
+                # floods fresh membership + fresh messages at the edge.
+                period = s // cfg.sync_period_boundary
+                for j in range(max(4, sync_n)):
+                    member = (period * 17 + j * 5 + 3) % pool
+                    emit(
+                        self._arrival(rng, base, phase),
+                        WorkType.GOSSIP_SYNC_SIGNATURE, "sync", s,
+                        (member,),
+                        _msg32(f"lhtpu-sync-rotate|{period}|{j % 2}"),
+                        rng.random() < cfg.poison_rate,
+                    )
 
         raw.sort(key=lambda r: (r[0], r[1]))
         return [
@@ -279,5 +425,7 @@ def stream_digest(events: list[TimedEvent]) -> str:
             f"{p.slot}|{int(p.expected)}|{int(p.forked)}|"
             f"{','.join(map(str, p.members))}|".encode()
         )
+        if p.votes:  # slashing-flood only; absent = legacy digest
+            h.update(f"{p.votes}|".encode())
         h.update(p.message)
     return h.hexdigest()

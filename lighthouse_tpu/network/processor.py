@@ -105,32 +105,37 @@ class WorkClass(str, Enum):
     ``BLOCK`` is chain-critical — it unblocks attestation processing for
     the whole slot, so the continuous scheduler (``loadgen/scheduler.py``)
     dispatches it immediately, preempting any coalescing window, and
-    never sheds it. ``AGGREGATE`` carries the highest verification value
-    per signature (one aggregate ≈ a whole committee) and coalesces only
-    briefly; ``ATTESTATION`` and ``SYNC`` are high-volume, individually
-    low-value gossip that coalesces up to its deadline and sheds first
-    under overload.
+    never sheds it. ``SLASHING`` is the block-adjacent lane: rare,
+    chain-impacting evidence (AttesterSlashing/ProposerSlashing) that a
+    flood scenario can turn into a firehose — it outranks attestations
+    but IS sheddable, and the scheduler's starvation guard keeps it from
+    monopolizing the device. ``AGGREGATE`` carries the highest
+    verification value per signature (one aggregate ≈ a whole committee)
+    and coalesces only briefly; ``ATTESTATION`` and ``SYNC`` are
+    high-volume, individually low-value gossip that coalesces up to its
+    deadline and sheds first under overload.
     """
 
     BLOCK = "block"
+    SLASHING = "slashing"
     AGGREGATE = "aggregate"
     ATTESTATION = "attestation"
     SYNC = "sync"
 
 
 # Every WorkType maps to exactly one class. Judgment calls mirror the
-# reference's drain priorities: slashings ride with aggregates (rare,
-# chain-impacting), exits/status/range-serving ride with sync messages
-# (deferrable under load).
+# reference's drain priorities: slashings ride the block-adjacent
+# SLASHING lane (rare, chain-impacting, floodable), exits/status/
+# range-serving ride with sync messages (deferrable under load).
 WORK_CLASSES: dict[WorkType, WorkClass] = {
     WorkType.CHAIN_SEGMENT: WorkClass.BLOCK,
     WorkType.GOSSIP_BLOCK: WorkClass.BLOCK,
     WorkType.RPC_BLOCK: WorkClass.BLOCK,
     WorkType.DELAYED_IMPORT: WorkClass.BLOCK,
+    WorkType.GOSSIP_ATTESTER_SLASHING: WorkClass.SLASHING,
+    WorkType.GOSSIP_PROPOSER_SLASHING: WorkClass.SLASHING,
     WorkType.GOSSIP_AGGREGATE: WorkClass.AGGREGATE,
     WorkType.GOSSIP_SYNC_CONTRIBUTION: WorkClass.AGGREGATE,
-    WorkType.GOSSIP_ATTESTER_SLASHING: WorkClass.AGGREGATE,
-    WorkType.GOSSIP_PROPOSER_SLASHING: WorkClass.AGGREGATE,
     WorkType.GOSSIP_ATTESTATION: WorkClass.ATTESTATION,
     WorkType.GOSSIP_SYNC_SIGNATURE: WorkClass.SYNC,
     WorkType.GOSSIP_VOLUNTARY_EXIT: WorkClass.SYNC,
@@ -140,9 +145,12 @@ WORK_CLASSES: dict[WorkType, WorkClass] = {
 }
 
 # Dispatch order for class-level scheduling; also the reverse of the
-# shed order (SYNC sheds first, BLOCK never sheds).
+# shed order (SYNC sheds first, BLOCK never sheds). SLASHING sits right
+# under BLOCK — the scheduler's starvation guard (LHTPU_SCHED_STARVATION_MS)
+# is what keeps a slashing flood from starving the classes below it.
 CLASS_PRIORITY = (
     WorkClass.BLOCK,
+    WorkClass.SLASHING,
     WorkClass.AGGREGATE,
     WorkClass.ATTESTATION,
     WorkClass.SYNC,

@@ -16,6 +16,7 @@ MemoryStore) with zlib-compressed chunk values — the same shape the
 reference puts on MDBX.
 """
 
-from .slasher import Slasher, SlasherConfig
+from .arrays import SurroundEngine
+from .slasher import DeviceSlasher, Slasher, SlasherConfig
 
-__all__ = ["Slasher", "SlasherConfig"]
+__all__ = ["DeviceSlasher", "Slasher", "SlasherConfig", "SurroundEngine"]

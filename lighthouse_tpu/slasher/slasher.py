@@ -21,7 +21,13 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 
 from ..store.kv import MemoryStore
-from .arrays import TargetArrays
+from .arrays import (
+    CODE_DOUBLE,
+    CODE_SURROUNDED,
+    CODE_SURROUNDS,
+    SurroundEngine,
+    TargetArrays,
+)
 
 COL_ATT_BY_TARGET = b"slasher/att_by_target"   # (validator,target) -> data root
 COL_ATT_RECORDS = b"slasher/att_records"        # data_root -> ssz IndexedAttestation
@@ -223,3 +229,91 @@ class Slasher:
             signed_header_1=found.header_1,
             signed_header_2=found.header_2,
         )
+
+
+class DeviceSlasher(Slasher):
+    """Slasher whose surround/double-vote detection runs on the
+    SurroundEngine device planes (ISSUE 17).
+
+    The KV side — attestation records, the (validator, target) root
+    map, proposal keys, slashing containers — is inherited unchanged;
+    only the per-vote plane scan moves to device. Findings are
+    materialized in the host's exact per-vote order (double before
+    surround, map written only when absent), so the output is
+    bit-identical to the host ``Slasher`` oracle on any input, and the
+    engine's sticky host fallback keeps that true through faults.
+    """
+
+    def __init__(self, types, config: SlasherConfig | None = None,
+                 db=None, engine: SurroundEngine | None = None):
+        super().__init__(types, config, db)
+        self.engine = engine or SurroundEngine(
+            validator_chunk_size=self.config.validator_chunk_size,
+            history_length=self.config.history_length,
+        )
+
+    def process_queued(self, current_epoch: int) -> list:
+        found: list = []
+        atts, self._att_queue = self._att_queue, []
+        blocks, self._block_queue = self._block_queue, []
+
+        by_chunk: dict[int, list[tuple[int, object]]] = defaultdict(list)
+        for att in atts:
+            self.stats["attestations"] += 1
+            for vi in att.attesting_indices:
+                by_chunk[int(vi) // self.config.validator_chunk_size].append(
+                    (int(vi), att)
+                )
+        ordered = [
+            pair for ci in sorted(by_chunk) for pair in by_chunk[ci]
+        ]
+        codes = self.engine.process(
+            [
+                (vi, int(att.data.source.epoch), int(att.data.target.epoch))
+                for vi, att in ordered
+            ]
+        )
+        for (vi, att), code in zip(ordered, codes):
+            found.extend(self._materialize(vi, att, code))
+
+        for block in blocks:
+            self.stats["blocks"] += 1
+            found.extend(self._process_block(block))
+
+        self.stats["slashings"] += len(found)
+        return found
+
+    def _materialize(self, validator: int, att, code: int) -> list:
+        """Turn an engine code into findings with the host's exact
+        semantics and ordering, then record the vote in the KV maps
+        (plane updates already happened inside the engine)."""
+        source = int(att.data.source.epoch)
+        target = int(att.data.target.epoch)
+        out = []
+
+        key = self._att_key(validator, target)
+        prev_root = self.db.get(COL_ATT_BY_TARGET, key)
+        if code & CODE_DOUBLE and prev_root is not None:
+            prev = self._load_attestation(prev_root)
+            data_root = att.data.hash_tree_root()
+            if prev is not None and prev.data.hash_tree_root() != data_root:
+                out.append(
+                    AttesterSlashingFound("double", validator, prev, att)
+                )
+        # surrounded wins when both bits fire — check_surround returns
+        # early on "surrounded", and the host emits at most one
+        verdict = None
+        if code & CODE_SURROUNDED:
+            verdict = "surrounded"
+        elif code & CODE_SURROUNDS:
+            verdict = "surrounds"
+        if verdict is not None:
+            prior = self._find_conflicting(validator, source, target, verdict)
+            if prior is not None:
+                a1, a2 = (att, prior) if verdict == "surrounds" else (prior, att)
+                out.append(AttesterSlashingFound(verdict, validator, a1, a2))
+
+        root = self._store_attestation(att)
+        if prev_root is None:
+            self.db.put(COL_ATT_BY_TARGET, key, root)
+        return out

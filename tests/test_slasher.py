@@ -11,7 +11,7 @@ from lighthouse_tpu.consensus.types import (
     SignedBeaconBlockHeader,
     spec_types,
 )
-from lighthouse_tpu.slasher import Slasher, SlasherConfig
+from lighthouse_tpu.slasher import DeviceSlasher, Slasher, SlasherConfig
 from lighthouse_tpu.slasher.arrays import MAX_DISTANCE, TargetArrays
 from lighthouse_tpu.store.kv import MemoryStore
 
@@ -206,3 +206,118 @@ class TestSlasher:
         )
         _, attester = chain.op_pool.get_slashings(st)
         assert len(attester) == 1
+
+
+def _fingerprint(found):
+    return [
+        (
+            f.kind,
+            f.validator_index,
+            bytes(f.attestation_1.hash_tree_root()).hex()[:8],
+            bytes(f.attestation_2.hash_tree_root()).hex()[:8],
+        )
+        for f in found
+    ]
+
+
+def _small_config():
+    # Tiny chunks so a 24-validator history spans several device chunks.
+    return SlasherConfig(chunk_size=4, validator_chunk_size=8,
+                         history_length=64)
+
+
+def _adversarial_batches(seed, batches=4, per_batch=12):
+    """Seeded mix of double / surround pairs / clean votes over a small
+    validator set, dense enough that every batch collides somewhere."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for _ in range(batches):
+        batch = []
+        for _ in range(per_batch):
+            v = rng.randrange(24)
+            e0 = 1 + rng.randrange(20)
+            shape = rng.random()
+            if shape < 0.3:
+                root = bytes([rng.randrange(1, 250)])
+                batch.append(_att([v], e0, e0 + 1, beacon_root=root))
+                batch.append(_att([v], e0, e0 + 1, beacon_root=b"\xfe"))
+            elif shape < 0.6:
+                batch.append(_att([v], e0 + 1, e0 + 2))
+                batch.append(_att([v], e0, e0 + 3))
+            else:
+                batch.append(_att([v], e0, e0 + 1))
+        out.append(batch)
+    return out
+
+
+class TestDeviceSlasher:
+    """DeviceSlasher (slasher/arrays.py SurroundEngine) must be
+    bit-exact with the host Slasher: same findings, same kinds, same
+    attestation_1/attestation_2 ordering, batch by batch."""
+
+    def _run(self, slasher_cls, batches):
+        s = slasher_cls(T, config=_small_config())
+        prints = []
+        for batch in batches:
+            for att in batch:
+                s.accept_attestation(att)
+            prints.append(_fingerprint(s.process_queued(64)))
+        return s, prints
+
+    def test_seeded_history_parity(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_SLASHER_DEVICE", "0")
+        for seed in (1, 7, 42):
+            batches = _adversarial_batches(seed)
+            _, host = self._run(Slasher, batches)
+            _, dev = self._run(DeviceSlasher, batches)
+            assert dev == host
+            assert any(host)  # seeds chosen to actually find offenses
+
+    def test_crafted_case_parity(self, monkeypatch):
+        monkeypatch.setenv("LHTPU_SLASHER_DEVICE", "0")
+        crafted = [[
+            _att([1], 0, 1, beacon_root=b"\x01"),
+            _att([1], 0, 1, beacon_root=b"\x02"),  # double
+            _att([2], 3, 4),
+            _att([2], 2, 5),  # surrounds
+            _att([3], 1, 9),
+            _att([3], 4, 6),  # surrounded
+            _att([4], 0, 1),  # clean
+        ]]
+        _, host = self._run(Slasher, crafted)
+        _, dev = self._run(DeviceSlasher, crafted)
+        assert dev == host
+        kinds = sorted(k for (k, *_rest) in host[0])
+        assert kinds == ["double", "surrounded", "surrounds"]
+
+    def test_jax_device_mode_matches_host_mirror(self, monkeypatch):
+        pytest.importorskip("jax")
+        batches = _adversarial_batches(7)
+        monkeypatch.setenv("LHTPU_SLASHER_DEVICE", "0")
+        _, host_mode = self._run(DeviceSlasher, batches)
+        monkeypatch.setenv("LHTPU_SLASHER_DEVICE", "1")
+        s, dev_mode = self._run(DeviceSlasher, batches)
+        assert dev_mode == host_mode
+        rep = s.engine.report()
+        assert rep["degraded"] is False and rep["fallbacks"] == 0
+
+    def test_fault_degrades_with_identical_findings(self, monkeypatch):
+        from lighthouse_tpu.common import resilience
+
+        monkeypatch.setenv("LHTPU_SLASHER_DEVICE", "0")
+        batches = _adversarial_batches(7)
+        _, clean = self._run(DeviceSlasher, batches)
+        monkeypatch.setenv("LHTPU_FAULT_INJECT", "slasher:assert:1")
+        resilience.rearm_faults()
+        try:
+            s, faulted = self._run(DeviceSlasher, batches)
+        finally:
+            monkeypatch.delenv("LHTPU_FAULT_INJECT")
+            resilience.rearm_faults()
+        assert faulted == clean  # fault-safe: no finding lost or changed
+        rep = s.engine.report()
+        assert rep["fallbacks"] >= 1
+        assert rep["degraded"] is True
+        assert rep["fault_kinds"]

@@ -802,6 +802,142 @@ def run_drill_stream():
     return results
 
 
+def run_drill_weather():
+    """Chain-weather drill (ISSUE 17): faults injected while the
+    stream is under adversarial weather.
+
+    * ``reorg_transient`` — a transient dispatch fault during a reorg
+      storm retries in place: zero mismatches, >=1 retry, no rung
+      degradation, competing-head blocks all served (never shed);
+    * ``flood_permanent`` — a permanent fault mid slashing-flood
+      degrades down the ladder without shedding a single block, and
+      attestations keep being served (the starvation guard's contract);
+    * ``slasher`` — a ``slasher``-stage fault falls back to the host
+      scan with IDENTICAL findings: the degraded run's findings digest
+      must equal the clean run's bit-for-bit, with >=1 recorded
+      fallback.
+
+    Every cell additionally requires its scenario SLOs to pass."""
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
+    from lighthouse_tpu.loadgen.scheduler import (
+        SchedulerConfig,
+        StreamRunner,
+    )
+    from lighthouse_tpu.loadgen.serve import VirtualClock
+    from lighthouse_tpu.loadgen.traffic import TrafficConfig
+
+    backend = jb.JaxBackend()
+    traffic = TrafficConfig(
+        validators=64, slots=2, seconds_per_slot=2.0,
+        committees_per_slot=2, committee_size=2,
+        unaggregated_per_slot=2, sync_per_slot=1, blocks=True,
+        poison_rate=0.25, key_pool=8, seed=7, peers=4,
+    )
+
+    def _run(chaos: str, weather: str) -> dict:
+        runner = StreamRunner(
+            traffic, 2,
+            SchedulerConfig(
+                batch_target=4, agg_deadline_ms=100.0,
+                att_deadline_ms=100.0, sync_deadline_ms=100.0,
+                slashing_deadline_ms=100.0, dispatch_ms=0.0, cache=False,
+            ),
+            clock=VirtualClock(),
+            verify=lambda sets: backend.verify_signature_sets_triaged(sets),
+            chaos=chaos, emit=None, weather=weather,
+        )
+        return runner.run()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS",
+                  "LHTPU_SLASHER_DEVICE", "LHTPU_SLASHER_CHUNK",
+                  "LHTPU_SLASHER_HISTORY")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    # Drill-sized sink engine on the host scan: the fault/fallback
+    # contract is mode-independent and this keeps the matrix compiles
+    # pinned to the cached buckets.
+    os.environ["LHTPU_SLASHER_DEVICE"] = "0"
+    os.environ["LHTPU_SLASHER_CHUNK"] = "64"
+    os.environ["LHTPU_SLASHER_HISTORY"] = "64"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+
+    flood = "*:slashing_flood:2.0"
+    cells = (
+        ("remote_compile", "reorg_transient",
+         "0:dispatch:remote_compile:1", "*:reorg_storm:0.9"),
+        ("mosaic", "flood_permanent", "0:dispatch:mosaic:1", flood),
+        ("assert", "slasher", "0:slasher:assert:1", flood),
+    )
+    results = []
+    try:
+        resilience.reset()
+        clean_digest = _run("", flood)["sched"]["slasher"]["findings_digest"]
+
+        for kind, category, chaos, weather in cells:
+            resilience.reset()
+            retries0 = _total(resilience.RETRIES_TOTAL)
+            degraded0 = _total(resilience.DEGRADED_TOTAL)
+            error = None
+            rep = None
+            try:
+                rep = _run(chaos, weather)
+            except Exception as exc:  # contract breach, not a crash
+                cat, kind_c = resilience.classify(exc)
+                error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
+            retries = _total(resilience.RETRIES_TOTAL) - retries0
+            degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+            if rep is None:
+                ok = False
+            else:
+                block = rep["sched"]["block"]
+                base_ok = (rep["verdicts"]["mismatches"] == 0
+                           and block["shed"] == 0
+                           and block["dropped"] == 0
+                           and rep["accounting"]["balanced"]
+                           and rep["scenarios"]["ok"])
+                if category == "reorg_transient":
+                    ok = base_ok and retries >= 1 and degraded == 0
+                elif category == "flood_permanent":
+                    served = rep["slo"]["per_class"]
+                    ok = (base_ok and degraded >= 1
+                          and served["attestation"]["served"] > 0)
+                else:  # slasher fault: host fallback, findings intact
+                    sl = rep["sched"]["slasher"]
+                    engine = sl["engine"] or {}
+                    ok = (base_ok
+                          and engine.get("fallbacks", 0) >= 1
+                          and sl["findings_digest"] == clean_digest)
+            results.append({
+                "mode": "weather",
+                "stage": "slasher" if category == "slasher"
+                         else "dispatch",
+                "kind": kind,
+                "category": category,
+                "verdict": (rep["verdicts"]["mismatches"] == 0
+                            if rep is not None else None),
+                "retries": retries,
+                "degraded": degraded,
+                "path": backend.last_path,
+                "healthy_path": None,
+                "error": error,
+                "ok": ok,
+            })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+    return results
+
+
 def main() -> int:
     json_mode = "--json" in sys.argv
     stages = QUICK_STAGES if "--quick" in sys.argv else STAGES
@@ -828,7 +964,7 @@ def main() -> int:
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     n_multichip = len(MULTICHIP_KINDS) if len(jax.devices()) > 1 else 0
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip + 4}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip + 4 + 3}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -852,6 +988,11 @@ def main() -> int:
     # transform, blocks are never shed, and preemption-abandoned
     # batches re-enqueue exactly once.
     results += run_drill_stream()
+    # Chain-weather matrix (ISSUE 17): faults during reorg storms and
+    # slashing floods — retries in place / ladder degradation with
+    # blocks never shed, and a slasher-stage fault falling back to the
+    # host scan with bit-identical findings.
+    results += run_drill_weather()
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "

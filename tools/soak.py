@@ -47,6 +47,14 @@ def main() -> int:
     ap.add_argument("--committee-size", type=int, default=2)
     ap.add_argument("--unagg", type=int, default=4,
                     help="unaggregated attestations per slot")
+    ap.add_argument("--sync", type=int, default=None,
+                    help="sync signatures per slot (default: spec-shaped"
+                         " derivation from the committee shape)")
+    ap.add_argument("--weather",
+                    default=knobs.knob("LHTPU_WEATHER_SCHEDULE"),
+                    help="epoch:axis:value[;...] chain-weather plan "
+                         "(axes: reorg_storm / non_finality / "
+                         "slashing_flood / sync_boundary; epoch * = all)")
     ap.add_argument("--poison", type=float, default=0.25)
     ap.add_argument("--key-pool", type=int, default=8)
     ap.add_argument("--recovery-epochs", type=int, default=2,
@@ -94,12 +102,14 @@ def main() -> int:
         wall_clock=args.wall_clock,
         recovery_epochs=args.recovery_epochs,
         replay=not args.no_replay,
+        weather=args.weather,
         traffic=TrafficConfig(
             slots=args.slots,
             seconds_per_slot=args.sps,
             committees_per_slot=args.committees,
             committee_size=args.committee_size,
             unaggregated_per_slot=args.unagg,
+            sync_per_slot=args.sync,
             poison_rate=args.poison,
             key_pool=args.key_pool,
             seed=args.seed,
