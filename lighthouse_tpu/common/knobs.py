@@ -204,6 +204,12 @@ _ALL: tuple[Knob, ...] = (
     Knob("LHTPU_MXU_FOLD", "optstr", None,
          "Force the MXU Montgomery fold on (1) / off (0); unset = on when the backend is TPU",
          "lighthouse_tpu/ops/tkernel.py"),
+    Knob("LHTPU_LAZY_REDUCE", "bool", False,
+         "Lazy-reduction tower arithmetic: normalize once per line function (hardware-gated; see tkernel)",
+         "lighthouse_tpu/ops/tkernel.py"),
+    Knob("LHTPU_MXU_CARRY", "bool", False,
+         "Carry propagation as banded-Toeplitz MXU matmuls instead of serial chains (hardware-gated)",
+         "lighthouse_tpu/ops/tkernel.py"),
     Knob("LHTPU_HTC_MXU_LADDER", "optstr", None,
          "Force Fp2 muln stacking in the ladder kernels on (1) / off (0); unset = follow the MXU fold",
          "lighthouse_tpu/ops/tkernel.py"),

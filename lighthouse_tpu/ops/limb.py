@@ -225,11 +225,110 @@ def _carry_scan(t):
     return jnp.moveaxis(out, 0, -1), carry
 
 
+# MXU carry fold (ISSUE 18 tentpole b): the byte-regroup passes of a
+# carry normalization are constant banded-Toeplitz matmuls — the same
+# trick as _conv_schoolbook — leaving only a log-depth binary-carry
+# prefix on the VPU, instead of 48 sequential scan steps per instance
+# (57k instances per set in the roofline count). Default OFF
+# (LHTPU_MXU_CARRY) until hardware-proven, per the r4 rule. Requires
+# NONNEGATIVE digits, so the gated ops below use the complement forms
+# (a - b as a + ~b + 1; x - kp as x + (2^384 - kp), carry bit = the
+# comparison) exactly like ops/tkernel.py's Kogge-Stone branches.
+
+COMP_P_LIMBS = jnp.asarray(int_to_limbs((1 << R_BITS) - P))
+COMP_TWO_P_LIMBS = jnp.asarray(int_to_limbs((1 << R_BITS) - 2 * P))
+
+_REGROUP_MATS: dict = {}
+
+
+def _regroup_mat(rows: int, planes: int):
+    """[planes*rows, rows] f32: out[j+k] += plane_k[j] as one einsum."""
+    key = (rows, planes)
+    if key not in _REGROUP_MATS:
+        w = np.zeros((planes * rows, rows), np.float32)
+        for k in range(planes):
+            for j in range(rows - k):
+                w[k * rows + j, j + k] = 1.0
+        _REGROUP_MATS[key] = jnp.asarray(w)
+    return _REGROUP_MATS[key]
+
+
+def _mxu_carry_enabled() -> bool:
+    from ..common import knobs
+
+    return bool(knobs.knob("LHTPU_MXU_CARRY"))
+
+
+def _shift_last(x, s: int, fill):
+    """out[i] = x[i - s] along the trailing limb axis."""
+    pad = jnp.full((*x.shape[:-1], s), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-s]], axis=-1)
+
+
+def _carry_mxu(t, bound: int):
+    """Carry propagation for NONNEGATIVE digits in [0, bound], with the
+    regroup on the MXU. Same contract as :func:`_carry_scan` restricted
+    to nonnegative inputs: returns ([0, 255] limbs, carry_out >= 0).
+
+    Each regroup pass folds the three byte planes back into digit
+    positions via one banded 0/1 matmul (f32-exact: plane digits < 2^16,
+    three terms per output). Digits <= 510 afterwards make every
+    remaining carry binary, resolved by a 6-step Kogge-Stone
+    (generate, propagate) prefix — no fixed-precision matmul can absorb
+    a 255-run ripple, so the prefix stays on the VPU."""
+    rows = t.shape[-1]
+    top = rows - 1
+    hp = jax.lax.Precision.HIGHEST
+    c_out = jnp.zeros_like(t[..., 0])
+    while bound > 510:
+        two = bound >= (1 << (2 * LIMB_BITS))
+        lo = t & LIMB_MASK
+        if two:
+            c1 = (t >> LIMB_BITS) & LIMB_MASK
+            c2 = t >> (2 * LIMB_BITS)
+            planes = jnp.concatenate([lo, c1, c2], axis=-1)
+            c_out = (
+                c_out
+                + c1[..., top]
+                + c2[..., top - 1]
+                + (c2[..., top] << LIMB_BITS)
+            )
+            mat = _regroup_mat(rows, 3)
+            bound = 255 + 255 + (bound >> (2 * LIMB_BITS))
+        else:
+            c1 = t >> LIMB_BITS
+            planes = jnp.concatenate([lo, c1], axis=-1)
+            c_out = c_out + c1[..., top]
+            mat = _regroup_mat(rows, 2)
+            bound = 255 + (bound >> LIMB_BITS)
+        t = jnp.round(jnp.einsum(
+            "...i,ik->...k", planes.astype(jnp.float32), mat,
+            precision=hp,
+        )).astype(jnp.int32)
+    g = t >= 256
+    pr = t == 255
+    s = 1
+    while s < rows:
+        g = g | (pr & _shift_last(g, s, False))
+        pr = pr & _shift_last(pr, s, True)
+        s *= 2
+    c_in = _shift_last(g, 1, False).astype(jnp.int32)
+    return (t + c_in) & LIMB_MASK, c_out + g[..., top].astype(jnp.int32)
+
+
 # --------------------------------------------------------------- add/sub/neg
 
 
 def add(a, b):
     """(a + b) mod-ish: result ≡ a+b (mod p), in [0, 2p), limbs normalized."""
+    if _mxu_carry_enabled():
+        s_raw = jnp.broadcast_to(
+            a + b, jnp.broadcast_shapes(a.shape, b.shape)
+        )
+        both, carries = _carry_mxu(
+            jnp.stack([s_raw, s_raw + COMP_TWO_P_LIMBS]), bound=765
+        )
+        return jnp.where((carries[1] == 1)[..., None], both[1], both[0])
     s, _ = _carry_scan(a + b)                    # value < 4p < 2^384
     d, borrow = _carry_scan(s - TWO_P_LIMBS)     # s - 2p
     take_d = (borrow == 0)[..., None]            # s >= 2p
@@ -238,6 +337,17 @@ def add(a, b):
 
 def sub(a, b):
     """(a - b) mod-ish: result ≡ a-b (mod p), in [0, 2p)."""
+    if _mxu_carry_enabled():
+        # a - b as the complement sum a + (2^384-1 - b) + 1: digit-wise
+        # nonnegative, carry bit == (a >= b); +2p stacks alongside.
+        base = jnp.broadcast_to(
+            a + (LIMB_MASK - b),
+            jnp.broadcast_shapes(a.shape, b.shape),
+        ) + ONE_LIMBS
+        both, carries = _carry_mxu(
+            jnp.stack([base, base + TWO_P_LIMBS]), bound=766
+        )
+        return jnp.where((carries[0] == 1)[..., None], both[0], both[1])
     d2, borrow = _carry_scan(a - b)
     d1, _ = _carry_scan(a - b + TWO_P_LIMBS)
     take_d2 = (borrow == 0)[..., None]           # a >= b
@@ -339,6 +449,11 @@ def mont_mul(a, b):
         return jnp.roll(t, -1, axis=-1), None
 
     t, _ = jax.lax.scan(step, t, None, length=N_LIMBS)
+    if _mxu_carry_enabled():
+        # fold digits are nonnegative and < 2^23 + 255 (conv columns
+        # < 2^22 plus 48 fold adds) — same bound as the tkernel path
+        out, _ = _carry_mxu(t[..., :N_LIMBS], bound=(1 << 23) + 255)
+        return out
     out, _ = _carry_scan(t[..., :N_LIMBS])
     return out
 
@@ -397,6 +512,9 @@ def from_mont(a):
 
 def canonical(a):
     """Fully reduce an almost-reduced value into [0, p)."""
+    if _mxu_carry_enabled():
+        d, carry = _carry_mxu(a + COMP_P_LIMBS, bound=510)
+        return jnp.where((carry == 1)[..., None], d, a)
     d, borrow = _carry_scan(a - P_LIMBS)
     take_d = (borrow == 0)[..., None]
     return jnp.where(take_d, d, a)

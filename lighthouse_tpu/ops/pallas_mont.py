@@ -76,8 +76,33 @@ def _mont_mul_kernel(a_ref, b_ref, p_ref, out_ref, t_ref):
         carry = v >> LIMB_BITS
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _mont_mul_flat(a, b, interpret: bool = False):
+def _mont_mul_kernel_mxu(a_ref, b_ref, p_ref, out_ref, t_ref):
+    """LHTPU_MXU_CARRY variant: same conv + CIOS fold, but the final
+    48-step carry row-walk becomes banded-Toeplitz regroup matmuls +
+    a Kogge-Stone prefix (tk._carry_norm_mxu — consts-free, so it
+    traces inside the kernel body without the bound_consts bundle)."""
+    p_col = p_ref[:]
+    b_all = b_ref[:]
+
+    t_ref[0:N_LIMBS, :] = b_all * a_ref[0, :][None, :]
+    t_ref[N_LIMBS:_ROWS, :] = jnp.zeros_like(t_ref[N_LIMBS:_ROWS, :])
+    for i in range(1, N_LIMBS):
+        t_ref[i:i + N_LIMBS, :] += b_all * a_ref[i, :][None, :]
+
+    for i in range(N_LIMBS):
+        trow = t_ref[i, :]
+        m = (trow * NINV8) & LIMB_MASK
+        t_ref[i:i + N_LIMBS, :] += p_col * m[None, :]
+        t_ref[i + 1, :] += (trow + m * _P0) >> LIMB_BITS
+
+    out, _ = tk._carry_norm_mxu(
+        t_ref[N_LIMBS:_ROWS, :], bound=(1 << 23) + 255
+    )
+    out_ref[:] = out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "mxu_carry"))
+def _mont_mul_flat(a, b, interpret: bool = False, mxu_carry: bool = False):
     """a, b: int32[M, 48] → int32[M, 48] (transposition handled here)."""
     m = a.shape[0]
     # small batches get a lane-width tile instead of padding to TILE_T
@@ -92,7 +117,7 @@ def _mont_mul_flat(a, b, interpret: bool = False):
 
     spec_in = pl.BlockSpec((N_LIMBS, tile), lambda i: (0, i))
     out = pl.pallas_call(
-        _mont_mul_kernel,
+        _mont_mul_kernel_mxu if mxu_carry else _mont_mul_kernel,
         out_shape=jax.ShapeDtypeStruct((N_LIMBS, m_pad), jnp.int32),
         grid=(m_pad // tile,),
         in_specs=[spec_in, spec_in,
@@ -115,4 +140,6 @@ def mont_mul_pallas(a, b):
     a = jnp.broadcast_to(a, shape).reshape(-1, N_LIMBS)
     b = jnp.broadcast_to(b, shape).reshape(-1, N_LIMBS)
     interpret = jax.default_backend() != "tpu"
-    return _mont_mul_flat(a, b, interpret=interpret).reshape(shape)
+    return _mont_mul_flat(
+        a, b, interpret=interpret, mxu_carry=tk._mxu_carry_enabled()
+    ).reshape(shape)

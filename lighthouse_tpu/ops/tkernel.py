@@ -289,6 +289,31 @@ def batch_from_t(a):
 # ------------------------------------------------------------- carry logic
 
 
+#: trace-time op-instance counter (None = off). Methodology matches the
+#: README roofline: one STACKED call-site instance counts 1 regardless
+#: of stack width — the serial-dependency cost the VPU pays is per
+#: instance, not per stacked value. Enabled via count_ops(); zero
+#: overhead when off (a dict-is-None test per instrumented call).
+_OP_COUNTS: dict | None = None
+
+
+def _count(event: str, n: int = 1) -> None:
+    if _OP_COUNTS is not None:
+        _OP_COUNTS[event] = _OP_COUNTS.get(event, 0) + n
+
+
+@contextlib.contextmanager
+def count_ops():
+    """Collect per-instance op counts during a trace (jax.eval_shape is
+    enough — no compile needed). Yields the counts dict."""
+    global _OP_COUNTS
+    prev, _OP_COUNTS = _OP_COUNTS, {}
+    try:
+        yield _OP_COUNTS
+    finally:
+        counts, _OP_COUNTS = _OP_COUNTS, prev
+
+
 def _carry_norm(t):
     """Full carry propagation over the limb axis (-2). Signed inputs OK
     (arithmetic shift); returns (normalized limbs, carry_out[...]).
@@ -296,6 +321,7 @@ def _carry_norm(t):
     Scan-with-roll structure (mirroring limb._carry_scan): static row-0
     access per step keeps the traced graph ~5 ops instead of ~200 — the
     unrolled form made XLA-CPU compiles of kernel bodies pathological."""
+    _count("carry_serial")
     rows = t.shape[-2]
 
     def step(_, carry):
@@ -329,6 +355,23 @@ def _shift_rows(x, s: int, fill):
     out[i] = x[i - s], rows below s filled with ``fill``."""
     pad = jnp.full((*x.shape[:-2], s, x.shape[-1]), fill, x.dtype)
     return jnp.concatenate([pad, x[..., :-s, :]], axis=-2)
+
+
+def _poison_check(t, bound: int):
+    """LHTPU_KS_CHECK digit-range contract (shared by every fast carry
+    path): eager inputs get a hard Python assert; traced inputs get +341
+    on every digit on violation (341 mod 256 != 0, so the corruption
+    survives the byte masks and no oracle-comparison test can miss it).
+    Read at TRACE time — same cache-key hazard as LHTPU_KS_CARRY."""
+    if _knobs.knob("LHTPU_KS_CHECK"):
+        bad = jnp.any((t < 0) | (t > bound))
+        if not isinstance(bad, jax.core.Tracer):
+            assert not bool(bad), (
+                f"fast carry: digits outside [0, {bound}]"
+            )
+        else:
+            t = t + bad.astype(t.dtype) * 341
+    return t
 
 
 def _carry_norm_ks(t, bound: int):
@@ -377,15 +420,9 @@ def _carry_norm_ks(t, bound: int):
     # single limb row that -1 would silently resurrect the
     # negative-index/dynamic_slice Mosaic hazard forbidden above.
     assert rows >= 2, f"_carry_norm_ks needs >= 2 limb rows, got {rows}"
+    _count("carry_ks")
     top = rows - 1
-    if _knobs.knob("LHTPU_KS_CHECK"):
-        bad = jnp.any((t < 0) | (t > bound))
-        if not isinstance(bad, jax.core.Tracer):
-            assert not bool(bad), (
-                f"_carry_norm_ks: digits outside [0, {bound}]"
-            )
-        else:
-            t = t + bad.astype(t.dtype) * 341
+    t = _poison_check(t, bound)
     c_out = jnp.zeros_like(t[..., 0, :])
     while bound > 510:
         two = bound >= (1 << (2 * LIMB_BITS))
@@ -407,6 +444,16 @@ def _carry_norm_ks(t, bound: int):
             c_out = c_out + c1[..., top, :]
             bound = 255 + (bound >> LIMB_BITS)
 
+    out, g_top = _ks_prefix(t)
+    return out, c_out + g_top
+
+
+def _ks_prefix(t):
+    """Kogge-Stone binary-carry resolution for digits in [0, 510]:
+    (generate, propagate) prefix over log2(rows) shift-combine steps.
+    Returns (normalized [0, 255] digits, int32 carry out of the top
+    row)."""
+    rows = t.shape[-2]
     g = t >= 256
     p = t == 255
     s = 1
@@ -416,7 +463,112 @@ def _carry_norm_ks(t, bound: int):
         s *= 2
     c_in = _shift_rows(g, 1, False).astype(jnp.int32)
     out = (t + c_in) & LIMB_MASK
-    return out, c_out + g[..., top, :].astype(jnp.int32)
+    return out, g[..., rows - 1, :].astype(jnp.int32)
+
+
+def _mxu_carry_enabled() -> bool:
+    """Carry regroup as banded-Toeplitz MXU matmuls (ISSUE 18 tentpole
+    b). Default OFF until hardware-proven — the r4 Kogge-Stone path
+    shipped default-ON without a TPU compile and zeroed the bench; this
+    knob follows the same discipline. Read at trace time."""
+    return bool(_knobs.knob("LHTPU_MXU_CARRY"))
+
+
+def _fast_carry_enabled() -> bool:
+    """Either log-depth carry path (Kogge-Stone shifts or MXU-folded
+    regroup) replaces the serial scan-with-roll."""
+    return _ks_enabled() or _mxu_carry_enabled()
+
+
+def _fast_carry(t, bound: int):
+    """Dispatch one nonnegative-digit carry normalization to the MXU
+    matmul regroup (LHTPU_MXU_CARRY) or the Kogge-Stone shift regroup.
+    Same contract as :func:`_carry_norm_ks`."""
+    if _mxu_carry_enabled():
+        return _carry_norm_mxu(t, bound)
+    return _carry_norm_ks(t, bound)
+
+
+def _regroup_mat(rows: int, planes: int):
+    """[rows, planes*rows] f32 banded-Toeplitz regroup matrix
+    ``W = [I | S1 | S2 ...]`` with S_k[i, j] = 1 iff i == j + k, built
+    from iotas at trace time (NOT a closed-over array constant — kernel
+    bodies may trace this; Mosaic lowers iota/compare/concat fine)."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (rows, rows), 1)
+    return jnp.concatenate(
+        [(i == j + k).astype(jnp.float32) for k in range(planes)], axis=1
+    )
+
+
+def _carry_norm_mxu(t, bound: int):
+    """Carry propagation with the byte regroup folded onto the MXU.
+
+    Same contract as :func:`_carry_norm_ks` (NONNEGATIVE digits in
+    [0, bound]; returns normalized [0, 255] digits + carry_out), but
+    each regroup pass — the dominant instruction cost of the shift
+    form, three full-tile adds plus masks per pass — is ONE constant
+    banded-Toeplitz matmul ``W @ [lo; c1; c2]`` riding the MXU, the
+    same trick as :func:`_mont_fold_mxu`'s quotient planes. The final
+    binary carries still resolve through the 6-step Kogge-Stone prefix
+    (an exact single-matmul carry is impossible: a 255-run ripple needs
+    the full 384-bit prefix, beyond any fixed-precision dot).
+
+    Exactness: matrix entries are 0/1 and plane digits stay < 2^16
+    (c2 <= bound >> 16 < 2^8 for every call-site bound), so each f32
+    dot output is < 3 * 2^16 — integer-exact. Dots loop over the
+    flattened leading axis like :func:`_mont_fold_mxu` (2-D MXU
+    contractions; elementwise stages ride the stacked array).
+    """
+    rows = t.shape[-2]
+    assert rows >= 2, f"_carry_norm_mxu needs >= 2 limb rows, got {rows}"
+    _count("carry_mxu")
+    top = rows - 1
+    t = _poison_check(t, bound)
+    hp = jax.lax.Precision.HIGHEST
+    lead = t.shape[:-2]
+    T = t.shape[-1]
+    flat = t.reshape((-1, rows, T))
+    L = flat.shape[0]
+    c_out = jnp.zeros_like(flat[:, 0, :])
+
+    def _dots(w, planes):
+        return jnp.stack([
+            jax.lax.dot_general(
+                w, planes[l], (((1,), (0,)), ((), ())), precision=hp
+            )
+            for l in range(L)
+        ]).astype(jnp.int32)
+
+    while bound > 510:
+        two = bound >= (1 << (2 * LIMB_BITS))
+        lo = flat & LIMB_MASK
+        if two:
+            c1 = (flat >> LIMB_BITS) & LIMB_MASK
+            c2 = flat >> (2 * LIMB_BITS)
+            planes = jnp.concatenate([lo, c1, c2], axis=-2)
+            _count("mxu_mac", 3 * rows * rows)
+            flat = _dots(_regroup_mat(rows, 3), planes.astype(jnp.float32))
+            c_out = (
+                c_out
+                + c1[:, top, :]
+                + c2[:, top - 1, :]
+                + (c2[:, top, :] << LIMB_BITS)
+            )
+            bound = 255 + 255 + (bound >> (2 * LIMB_BITS))
+        else:
+            c1 = flat >> LIMB_BITS
+            planes = jnp.concatenate([lo, c1], axis=-2)
+            _count("mxu_mac", 2 * rows * rows)
+            flat = _dots(_regroup_mat(rows, 2), planes.astype(jnp.float32))
+            c_out = c_out + c1[:, top, :]
+            bound = 255 + (bound >> LIMB_BITS)
+
+    out, g_top = _ks_prefix(flat)
+    return (
+        out.reshape((*lead, rows, T)),
+        (c_out + g_top).reshape((*lead, T)),
+    )
 
 
 def add_t(a, b):
@@ -430,8 +582,8 @@ def add_t(a, b):
     s_raw = a + b
     shape = jnp.broadcast_shapes(s_raw.shape, _c("TWO_P").shape)
     s_raw = jnp.broadcast_to(s_raw, shape)
-    if _ks_enabled():
-        both, carries = _carry_norm_ks(
+    if _fast_carry_enabled():
+        both, carries = _fast_carry(
             jnp.stack([s_raw, s_raw + _c("COMP_TWO_P")]), bound=765
         )
         s, d = both[0], both[1]
@@ -452,9 +604,9 @@ def sub_t(a, b):
     (digit-wise 255 - b, no borrows), whose carry bit is the a >= b
     test; + 2p stacks alongside."""
     shape = jnp.broadcast_shapes(a.shape, b.shape, _c("TWO_P").shape)
-    if _ks_enabled():
+    if _fast_carry_enabled():
         base = jnp.broadcast_to(a + (LIMB_MASK - b), shape) + _c("ONE_STD")
-        both, carries = _carry_norm_ks(
+        both, carries = _fast_carry(
             jnp.stack([base, base + _c("TWO_P")]), bound=766
         )
         d2, d1 = both[0], both[1]
@@ -548,6 +700,7 @@ def _mont_fold_mxu(t):
     into the high half is < 2^15 and is recovered exactly from the top
     six low digits (tail below digit 42 contributes < 2^-25).
     """
+    _count("mxu_mac", 3 * N_LIMBS * N_LIMBS + 2 * N_LIMBS * N_LIMBS)
     lead = t.shape[:-2]
     T = t.shape[-1]
     hp = jax.lax.Precision.HIGHEST
@@ -602,25 +755,46 @@ def _mont_fold_mxu(t):
     return out.reshape((*lead, N_LIMBS, T))
 
 
-def mont_mul_t(a, b):
-    """Montgomery product on the transposed layout; broadcast over leading
-    axes. Grouped static schoolbook conv + CIOS fold-with-roll + carry.
+def _mont_fold_cios(t):
+    """CIOS Montgomery fold on int32[..., 96, T] conv digits; sequential
+    by construction (each limb's quotient digit m depends on the running
+    row 0). Signed-digit safe: ``& LIMB_MASK`` and ``>> LIMB_BITS`` are
+    mod-256 / floor on two's-complement int32. Returns the rolled
+    [..., 96, T] buffer whose FIRST 48 rows are the folded result."""
+    _count("fold_vpu_mac", N_LIMBS * N_LIMBS)
+    p_col = _c("P")
 
-    The conv processes limbs in static groups: the grp shifted-b
-    operands are materialized once and each group touches one
+    def fold_step(_, t):
+        m = (t[..., 0, :] * NINV8) & LIMB_MASK
+        head = t[..., :N_LIMBS, :] + p_col * m[..., None, :]
+        carry = head[..., 0, :] >> LIMB_BITS
+        row1 = head[..., 1:2, :] + carry[..., None, :]
+        # consumed row 0 drops off; fresh zero row enters at the top —
+        # the roll fused into the concat
+        return jnp.concatenate(
+            [row1, head[..., 2:, :], t[..., N_LIMBS:, :],
+             jnp.zeros_like(row1)],
+            axis=-2,
+        )
+
+    return jax.lax.fori_loop(0, N_LIMBS, fold_step, t)
+
+
+def _mont_conv(a, b, lanes_match: bool):
+    """48-term schoolbook convolution t = a * b on pre-broadcast equal
+    shapes: int32[..., 48, T] x 2 -> int32[..., 96, T] digits < 48*255^2.
+
+    Grouped static windows when lanes matched pre-broadcast (the grp
+    shifted-b operands are materialized once and each group touches one
     (48+grp)-row window — far less data movement than the original
-    per-limb rotate-by-concat loop (measured v5e: the engine is
+    per-limb rotate-by-concat loop; measured v5e: the engine is
     VMEM-bandwidth/instruction bound on the rolls). Products with a
     lane-1 constant operand keep the roll form: their operand broadcast
     would need a combined sublane+lane broadcast Mosaic does not
-    implement. The fold keeps the roll form either way: its per-limb m
-    chain is sequential by construction (CIOS)."""
-    lanes_match = a.shape[-1] == b.shape[-1]  # BEFORE broadcasting
-    shape = jnp.broadcast_shapes(a.shape, b.shape)
-    a = jnp.broadcast_to(a, shape)
-    b = jnp.broadcast_to(b, shape)
-    p_col = _c("P")
-
+    implement."""
+    _count("mont_product")
+    _count("conv_mac", N_LIMBS * N_LIMBS)
+    shape = a.shape
     if lanes_match and shape[-1] != 1:
         grp = _GROUP_LOWMEM if _lowmem() else _GROUP
         assert N_LIMBS % grp == 0, "conv group must divide the limb count"
@@ -672,6 +846,20 @@ def mont_mul_t(a, b):
             0, N_LIMBS, conv_step,
             (jnp.concatenate([zero_rows, zero_rows], axis=-2), a, b96),
         )
+    return t
+
+
+def mont_mul_t(a, b):
+    """Montgomery product on the transposed layout; broadcast over leading
+    axes. Grouped static schoolbook conv (:func:`_mont_conv`) + CIOS
+    fold-with-roll + carry (or the MXU fold). The fold keeps the roll
+    form either way: its per-limb m chain is sequential by construction
+    (CIOS)."""
+    lanes_match = a.shape[-1] == b.shape[-1]  # BEFORE broadcasting
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    t = _mont_conv(a, b, lanes_match)
 
     if _mxu_fold_enabled():
         # The byte regroup can leave the quotient's top digit at 256
@@ -682,8 +870,8 @@ def mont_mul_t(a, b):
         f = _mont_fold_mxu(t)
         shape = jnp.broadcast_shapes(f.shape, _c("TWO_P").shape)
         f = jnp.broadcast_to(f, shape)
-        if _ks_enabled():
-            both, carries = _carry_norm_ks(
+        if _fast_carry_enabled():
+            both, carries = _fast_carry(
                 jnp.stack([f, f + _c("COMP_TWO_P")]), bound=(1 << 23) + 255
             )
             s, d = both[0], both[1]
@@ -694,22 +882,9 @@ def mont_mul_t(a, b):
         borrow = carries[1]
         return jnp.where((borrow == 0)[..., None, :], d, s)
 
-    def fold_step(_, t):
-        m = (t[..., 0, :] * NINV8) & LIMB_MASK
-        head = t[..., :N_LIMBS, :] + p_col * m[..., None, :]
-        carry = head[..., 0, :] >> LIMB_BITS
-        row1 = head[..., 1:2, :] + carry[..., None, :]
-        # consumed row 0 drops off; fresh zero row enters at the top —
-        # the roll fused into the concat
-        return jnp.concatenate(
-            [row1, head[..., 2:, :], t[..., N_LIMBS:, :],
-             jnp.zeros_like(row1)],
-            axis=-2,
-        )
-
-    t = jax.lax.fori_loop(0, N_LIMBS, fold_step, t)
-    if _ks_enabled():
-        out, _ = _carry_norm_ks(t[..., :N_LIMBS, :], bound=(1 << 23) + 255)
+    t = _mont_fold_cios(t)
+    if _fast_carry_enabled():
+        out, _ = _fast_carry(t[..., :N_LIMBS, :], bound=(1 << 23) + 255)
         return out
     out, _ = _carry_norm(t[..., :N_LIMBS, :])
     return out
@@ -749,8 +924,8 @@ def mont_inv_t(a):
 
 def canonical_t(a):
     """Reduce [0,2p) -> [0,p) for comparisons (limb.canonical)."""
-    if _ks_enabled():
-        d, carry = _carry_norm_ks(a + _c("COMP_P"), bound=510)
+    if _fast_carry_enabled():
+        d, carry = _fast_carry(a + _c("COMP_P"), bound=510)
         return jnp.where((carry == 1)[..., None, :], d, a)
     d, borrow = _carry_norm(a - _c("P"))
     return jnp.where((borrow == 0)[..., None, :], d, a)
@@ -779,6 +954,8 @@ fp2_double_t = double_t
 
 def fp2_mul_t(a, b):
     """Karatsuba, one stacked mont_mul (tower.fp2_mul transposed)."""
+    if _lazy_enabled():
+        return w_norm(w2_mul(w_strict(a), w_strict(b)))
     a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
     b0, b1 = b[..., 0, :, :], b[..., 1, :, :]
     t = mont_mul_t(
@@ -790,6 +967,8 @@ def fp2_mul_t(a, b):
 
 
 def fp2_sqr_t(a):
+    if _lazy_enabled():
+        return w_norm(w2_sqr(w_strict(a)))
     a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
     t = mont_mul_t(
         _stk([add_t(a0, a1), a0], -3),
@@ -846,6 +1025,8 @@ def _f6(a, i):
 
 def fp6_mul_t(a, b):
     """Toom/Karatsuba 6-product schedule (tower.fp6_mul transposed)."""
+    if _lazy_enabled():
+        return w_norm(w6_mul(w_strict(a), w_strict(b)))
     a0, a1, a2 = (_f6(a, i) for i in range(3))
     b0, b1, b2 = (_f6(b, i) for i in range(3))
     pairs = [
@@ -947,6 +1128,8 @@ def fp12_one_t(shape_like):
 
 
 def fp12_mul_t(a, b):
+    if _lazy_enabled():
+        return w_norm(w12_mul(w_strict(a), w_strict(b)))
     a0, a1 = _w(a, 0), _w(a, 1)
     b0, b1 = _w(b, 0), _w(b, 1)
     if _lowmem():
@@ -965,6 +1148,8 @@ def fp12_mul_t(a, b):
 
 
 def fp12_sqr_t(a):
+    if _lazy_enabled():
+        return w_norm(w12_sqr(w_strict(a)))
     a0, a1 = _w(a, 0), _w(a, 1)
     if _lowmem():
         t0 = fp6_mul_t(a0, a1)
@@ -1019,6 +1204,301 @@ def fp12_eq_t(a, b):
 
 def fp12_is_one_t(a):
     return fp12_eq_t(a, fp12_one_t(a[..., 0, 0, 0, :, :]))
+
+
+# --------------------------------------------------------- lazy reduction
+# ISSUE 18 tentpole (a): redundant-limb accumulators. The strict ops
+# above pay one stacked carry pass + compare-select restore per add/sub
+# and a [0, 2p) restore per product; the w_* forms below carry WIDE
+# (signed, multi-byte) limbs through whole add/sub/mul-by-xi chains and
+# normalize once — a single stacked carry pass per chain (w_norm), the
+# add_t trick generalized. Every op updates a trace-time bound ledger
+# (value and digit ranges as exact Python ints) and the exactness
+# preconditions of the conv / MXU fold / f32 carry estimate are ASSERTED
+# at trace time instead of assumed — the [0, 2p) invariant of
+# ops/limb.py becomes a per-chain ledger.
+#
+# Correctness domain: lazy values agree with the strict path mod p (the
+# Montgomery quotient of a wide product differs from the strict one by
+# multiples of R, so raw [0, 2p) representatives may differ by p) —
+# parity is therefore canonical_t-level, which is what every verdict
+# comparison uses. Gated by LHTPU_LAZY_REDUCE, default OFF (r4 rule:
+# carry reworks ship default-OFF until hardware-proven).
+
+_R384 = 1 << 384
+
+
+def _lazy_enabled() -> bool:
+    """Lazy-reduction tower arithmetic (read at TRACE time — same
+    cache-key hazard as LHTPU_KS_CARRY: flip before first trace)."""
+    return bool(_knobs.knob("LHTPU_LAZY_REDUCE"))
+
+
+class _Wide:
+    """Redundant-limb accumulator: ``d`` int32[..., 48, T] signed digits
+    plus exact Python-int bounds — value in [vmin, vmax], every digit in
+    [dmin, dmax]. Plain Python container, NOT a pytree: it must never
+    cross a fori_loop/scan boundary (ledgers are trace-time state)."""
+
+    __slots__ = ("d", "vmin", "vmax", "dmin", "dmax")
+
+    def __init__(self, d, vmin: int, vmax: int, dmin: int, dmax: int):
+        assert vmin <= vmax and dmin <= dmax
+        # int32 headroom for the next few elementwise ops
+        assert -(1 << 30) < dmin and dmax < (1 << 30), (
+            "lazy ledger: digit bound near int32 overflow — missing "
+            "squeeze in the chain"
+        )
+        self.d = d
+        self.vmin, self.vmax = vmin, vmax
+        self.dmin, self.dmax = dmin, dmax
+
+
+def w_strict(x) -> _Wide:
+    """Wrap strict [0, 2p) digits (any coefficient layout: Fp, Fp2, Fp6,
+    Fp12 — the ledger is per-tensor, conservatively shared by slots)."""
+    return _Wide(x, 0, 2 * P - 1, 0, 255)
+
+
+def w_add(a: _Wide, b: _Wide) -> _Wide:
+    return _Wide(a.d + b.d, a.vmin + b.vmin, a.vmax + b.vmax,
+                 a.dmin + b.dmin, a.dmax + b.dmax)
+
+
+def w_sub(a: _Wide, b: _Wide) -> _Wide:
+    """Plain digit-wise subtraction — digits (and the value) may go
+    negative; the ledger tracks it and w_norm/w_squeeze restore."""
+    return _Wide(a.d - b.d, a.vmin - b.vmax, a.vmax - b.vmin,
+                 a.dmin - b.dmax, a.dmax - b.dmin)
+
+
+def w_double(a: _Wide) -> _Wide:
+    return _Wide(a.d * 2, 2 * a.vmin, 2 * a.vmax, 2 * a.dmin, 2 * a.dmax)
+
+
+def w_neg(a: _Wide) -> _Wide:
+    return _Wide(-a.d, -a.vmax, -a.vmin, -a.dmax, -a.dmin)
+
+
+def _w_stack(ws, axis: int) -> _Wide:
+    return _Wide(
+        jnp.stack([w.d for w in ws], axis),
+        min(w.vmin for w in ws), max(w.vmax for w in ws),
+        min(w.dmin for w in ws), max(w.dmax for w in ws),
+    )
+
+
+def _w_part(w: _Wide, i: int, axis: int) -> _Wide:
+    """Slice one coefficient index off a static axis, sharing the (per-
+    tensor, hence conservative) ledger."""
+    idx = [slice(None)] * w.d.ndim
+    idx[axis] = i
+    return _Wide(w.d[tuple(idx)], w.vmin, w.vmax, w.dmin, w.dmax)
+
+
+def w_norm(w: _Wide):
+    """Restore strict [0, 2p) digits with ONE stacked carry pass.
+
+    Generalizes add_t's stacked-complement trick to arbitrary ledgers:
+    after a nonneg value shift (+j0*2p, digit-wise via the TWO_P row),
+    row_j = d + j*COMP_TWO_P for j = 0..jhi has value
+    V + j*(2^384 - 2p), whose carry-out is >= j  iff  V >= j*2p — a
+    monotone predicate in j. All rows ride one carry pass; the largest
+    true j selects V - j*2p in [0, 2p). Digits in [0, 255] on exit.
+
+    Fast-carry eligible only when digits are nonnegative; otherwise the
+    signed serial pass (value-exact for signed digits) resolves it.
+    """
+    _count("w_norm")
+    j0 = 0 if w.vmin >= 0 else -(w.vmin // (2 * P))
+    d = w.d + j0 * _c("TWO_P") if j0 else w.d
+    vmax = w.vmax + j0 * 2 * P
+    dmax = w.dmax + j0 * 255
+    jhi = vmax // (2 * P)
+    assert jhi <= 64, (
+        f"w_norm: value bound {vmax / float(2 * P):.1f}*2p too wide — "
+        "missing squeeze in the chain"
+    )
+    rows = jnp.stack([d + j * _c("COMP_TWO_P") for j in range(jhi + 1)])
+    if w.dmin >= 0 and _fast_carry_enabled():
+        out, carries = _fast_carry(rows, bound=dmax + jhi * 255)
+    else:
+        out, carries = _carry_norm(rows)
+    res = out[0]  # j = 0 always eligible when V < 2p
+    for j in range(1, jhi + 1):
+        sel = carries[j] >= j
+        if j < jhi:
+            sel = sel & jnp.logical_not(carries[j + 1] >= (j + 1))
+        res = jnp.where(sel[..., None, :], out[j], res)
+    return res
+
+
+def w_out(w: _Wide):
+    """Strict digits for a value leaving the lazy domain (loop-carried
+    state, kernel outputs). Identity when the ledger already PROVES
+    [0, 2p) value and [0, 255] digits — re-wrapping with w_strict is
+    then sound — else one stacked norm. Never hand ``w.d`` to strict
+    code directly: a slim that didn't trip leaves 510-digit / 4p-value
+    tensors behind, and w_strict would then understate the ledger."""
+    if w.vmin >= 0 and w.vmax < 2 * P and w.dmax <= 255:
+        return w.d
+    return w_norm(w)
+
+
+def w_squeeze(w: _Wide) -> _Wide:
+    """Full re-strictification (digits AND value): w_norm + fresh
+    ledger. Invoked automatically at product boundaries whose inputs
+    would break the conv/fold exactness bounds."""
+    return w_strict(w_norm(w))
+
+
+def _w_slim(w: _Wide, cap: int = 8) -> _Wide:
+    """Re-strictify at a tower-level boundary when the ledger went
+    signed or wider than cap*2p — one stacked pass covers every product
+    slot of the level at once (vs strict's pass per scalar op), and it
+    keeps the downstream w_norm stacks shallow. Also triggers on wide
+    digits (> 510, one lazy-add of headroom) so values reused across
+    several products squeeze ONCE here instead of per-product inside
+    w_mont_mul."""
+    if w.vmin < 0 or w.vmax > cap * 2 * P or w.dmax > 510:
+        return w_squeeze(w)
+    return w
+
+
+def w_slim_many(*ws):
+    """Slim several same-shape accumulators in ONE stacked carry pass
+    (stack -> slim -> unstack); a no-op passthrough when every ledger is
+    already strict-shaped."""
+    s = _w_slim(_w_stack(list(ws), 0))
+    return tuple(_w_part(s, i, 0) for i in range(len(ws)))
+
+
+def w_mont_mul(a: _Wide, b: _Wide) -> _Wide:
+    """Montgomery product of wide operands, WITHOUT the final [0, 2p)
+    compare-select restore — the output stays a ledgered accumulator
+    (digits < 2^24, value < a.vmax*b.vmax/R + 2p).
+
+    Exactness is digit-driven, asserted here: the int32 conv and the
+    MXU fold's f32 planes/carry-estimate stay integer-exact up to
+    48*510^2 conv digits (< 2^24 - 2^22, the m*p fold margin), so
+    operands are auto-squeezed when signed or wider than 510."""
+    if a.dmin < 0 or a.dmax > 510:
+        a = w_squeeze(a)
+    if b.dmin < 0 or b.dmax > 510:
+        b = w_squeeze(b)
+    assert N_LIMBS * a.dmax * b.dmax < (1 << 24) - (1 << 22), (
+        "lazy mont: conv digit bound breaks fold exactness"
+    )
+    lanes_match = a.d.shape[-1] == b.d.shape[-1]
+    shape = jnp.broadcast_shapes(a.d.shape, b.d.shape)
+    t = _mont_conv(
+        jnp.broadcast_to(a.d, shape), jnp.broadcast_to(b.d, shape),
+        lanes_match,
+    )
+    if _mxu_fold_enabled():
+        # regroup can leave the quotient m one multiple of 2^384 high
+        # (top digit 256): m < 1.004 * 2^384 -> m*p/R < 2p
+        f = _mont_fold_mxu(t)
+        vmax = a.vmax * b.vmax // _R384 + 2 * P
+    else:
+        f = _mont_fold_cios(t)[..., :N_LIMBS, :]
+        vmax = a.vmax * b.vmax // _R384 + P
+    return _Wide(f, 0, vmax, 0, 1 << 24)
+
+
+def w2_mul(a: _Wide, b: _Wide) -> _Wide:
+    """Fp2 Karatsuba on wide operands (coefficient axis -3), one stacked
+    lazy mont — the three products' sub/add recombination stays wide."""
+    a0, a1 = _w_part(a, 0, -3), _w_part(a, 1, -3)
+    b0, b1 = _w_part(b, 0, -3), _w_part(b, 1, -3)
+    t = w_mont_mul(
+        _w_stack([a0, a1, w_add(a0, a1)], -3),
+        _w_stack([b0, b1, w_add(b0, b1)], -3),
+    )
+    t0, t1, t2 = (_w_part(t, i, -3) for i in range(3))
+    return _w_stack([w_sub(t0, t1), w_sub(w_sub(t2, t0), t1)], -3)
+
+
+def w2_sqr(a: _Wide) -> _Wide:
+    a0, a1 = _w_part(a, 0, -3), _w_part(a, 1, -3)
+    t = w_mont_mul(
+        _w_stack([w_add(a0, a1), a0], -3),
+        _w_stack([w_sub(a0, a1), a1], -3),
+    )
+    return _w_stack([_w_part(t, 0, -3), w_double(_w_part(t, 1, -3))], -3)
+
+
+def w2_mul_by_xi(a: _Wide) -> _Wide:
+    a0, a1 = _w_part(a, 0, -3), _w_part(a, 1, -3)
+    return _w_stack([w_sub(a0, a1), w_add(a0, a1)], -3)
+
+
+def w6_mul(a: _Wide, b: _Wide) -> _Wide:
+    """fp6_mul_t's Toom/Karatsuba 6-product schedule, recombined wide."""
+    a0, a1, a2 = (_w_part(a, i, -4) for i in range(3))
+    b0, b1, b2 = (_w_part(b, i, -4) for i in range(3))
+    pairs = [
+        (a0, b0), (a1, b1), (a2, b2),
+        (w_add(a1, a2), w_add(b1, b2)),
+        (w_add(a0, a1), w_add(b0, b1)),
+        (w_add(a0, a2), w_add(b0, b2)),
+    ]
+    if _lowmem():
+        t0, t1, t2, s12, s01, s02 = (
+            _w_slim(w2_mul(x, y)) for x, y in pairs
+        )
+    else:
+        t = _w_slim(w2_mul(
+            _w_stack([x for x, _ in pairs], -4),
+            _w_stack([y for _, y in pairs], -4),
+        ))
+        t0, t1, t2, s12, s01, s02 = (_w_part(t, i, -4) for i in range(6))
+    c0 = w_add(w2_mul_by_xi(w_sub(w_sub(s12, t1), t2)), t0)
+    c1 = w_add(w_sub(w_sub(s01, t0), t1), w2_mul_by_xi(t2))
+    c2 = w_add(w_sub(w_sub(s02, t0), t2), t1)
+    return _w_stack([c0, c1, c2], -4)
+
+
+def w6_mul_by_v(a: _Wide) -> _Wide:
+    return _w_stack(
+        [w2_mul_by_xi(_w_part(a, 2, -4)), _w_part(a, 0, -4),
+         _w_part(a, 1, -4)],
+        -4,
+    )
+
+
+def w12_mul(a: _Wide, b: _Wide) -> _Wide:
+    a0, a1 = _w_part(a, 0, -5), _w_part(a, 1, -5)
+    b0, b1 = _w_part(b, 0, -5), _w_part(b, 1, -5)
+    if _lowmem():
+        t0 = _w_slim(w6_mul(a0, b0))
+        t1 = _w_slim(w6_mul(a1, b1))
+        s = _w_slim(w6_mul(w_add(a0, a1), w_add(b0, b1)))
+    else:
+        t = _w_slim(w6_mul(
+            _w_stack([a0, a1, w_add(a0, a1)], -5),
+            _w_stack([b0, b1, w_add(b0, b1)], -5),
+        ))
+        t0, t1, s = (_w_part(t, i, -5) for i in range(3))
+    c0 = w_add(t0, w6_mul_by_v(t1))
+    c1 = w_sub(w_sub(s, t0), t1)
+    return _w_stack([c0, c1], -5)
+
+
+def w12_sqr(a: _Wide) -> _Wide:
+    a0, a1 = _w_part(a, 0, -5), _w_part(a, 1, -5)
+    if _lowmem():
+        t0 = _w_slim(w6_mul(a0, a1))
+        s = _w_slim(w6_mul(w_add(a0, a1), w_add(a0, w6_mul_by_v(a1))))
+    else:
+        t = _w_slim(w6_mul(
+            _w_stack([a0, w_add(a0, a1)], -5),
+            _w_stack([a1, w_add(a0, w6_mul_by_v(a1))], -5),
+        ))
+        t0, s = _w_part(t, 0, -5), _w_part(t, 1, -5)
+    c0 = w_sub(w_sub(s, t0), w6_mul_by_v(t0))
+    c1 = w_double(t0)
+    return _w_stack([c0, c1], -5)
 
 
 # ---------------------------------------------------------------- FieldOps

@@ -113,6 +113,128 @@ def _mul_line_sparse(f, line, xp, yp):
     return _stk([c0, c1], -5)
 
 
+# ------------------------------------------------- lazy line functions
+# LHTPU_LAZY_REDUCE variants (ISSUE 18 tentpole a): the whole line
+# evaluation — products, doublings, the sparse f*line recombination —
+# rides tkernel's redundant-limb accumulators; adds/subs/mul-by-xi are
+# carry-free digit arithmetic, values reused across several products
+# re-strictify in ONE grouped stacked pass (w_slim_many), and the fp12
+# result normalizes ONCE per line function (w_norm over the full
+# coefficient stack) instead of once per scalar op. Verdict parity with
+# the strict path is mod-p exact (canonical_t-level; see the tkernel
+# lazy-section comment for why raw [0, 2p) representatives may differ).
+
+
+def _muln2_w(*pairs):
+    """_muln2 on wide accumulators; object identity marks squarings."""
+    return tuple(
+        tk.w2_sqr(a) if a is b else tk.w2_mul(a, b) for a, b in pairs
+    )
+
+
+def _dbl_step_lazy(T):
+    """_dbl_step on wide accumulators. Returns strict (loop-carried)
+    point digits and the WIDE line triple for the sparse product."""
+    Xc, Yc, Zc = (tk.w_strict(c) for c in T)
+    A_, B_, Zh, Z_sq = _muln2_w((Xc, Xc), (Yc, Yc), (Yc, Zc), (Zc, Zc))
+    A_, B_, Zh, Z_sq = tk.w_slim_many(A_, B_, Zh, Z_sq)
+    XB = tk.w_add(Xc, B_)
+    C_, S_ = _muln2_w((B_, B_), (XB, XB))
+    C_, = tk.w_slim_many(C_)
+    D_, = tk.w_slim_many(
+        tk.w_double(tk.w_sub(tk.w_sub(S_, A_), C_))
+    )
+    E_, = tk.w_slim_many(tk.w_add(tk.w_double(A_), A_))
+    F_, EX, EZ = _muln2_w((E_, E_), (E_, Xc), (E_, Z_sq))
+    X3, Z3 = tk.w_slim_many(
+        tk.w_sub(F_, tk.w_double(D_)), tk.w_double(Zh)
+    )
+    Y3a, lC = _muln2_w((E_, tk.w_sub(D_, X3)), (Z3, Z_sq))
+    Y3 = tk.w_sub(
+        Y3a, tk.w_double(tk.w_double(tk.w_double(C_)))
+    )
+    lA = tk.w_sub(EX, tk.w_double(B_))
+    lB = tk.w_neg(EZ)
+    return (tk.w_out(X3), tk.w_out(Y3), tk.w_out(Z3)), (lA, lB, lC)
+
+
+def _add_step_lazy(T, Qaff):
+    """_add_step on wide accumulators; same contract as
+    :func:`_dbl_step_lazy`."""
+    X1, Y1, Z1 = (tk.w_strict(c) for c in T)
+    xq, yq = (tk.w_strict(c) for c in Qaff)
+    Z1Z1, = tk.w_slim_many(tk.w2_sqr(Z1))
+    U2, Tz = _muln2_w((xq, Z1Z1), (Z1, Z1Z1))
+    S2 = tk.w2_mul(yq, Tz)
+    H, r = tk.w_slim_many(
+        tk.w_sub(U2, X1), tk.w_double(tk.w_sub(S2, Y1))
+    )
+    H2 = tk.w_double(H)
+    Z1H = tk.w_add(Z1, H)
+    I, HH, ZS, rr = _muln2_w((H2, H2), (H, H), (Z1H, Z1H), (r, r))
+    I, = tk.w_slim_many(I)
+    J, V = _muln2_w((H, I), (X1, I))
+    X3, Z3 = tk.w_slim_many(
+        tk.w_sub(tk.w_sub(rr, J), tk.w_double(V)),
+        tk.w_sub(tk.w_sub(ZS, Z1Z1), HH),
+    )
+    Y3a, Y3b, lA1, lA2 = _muln2_w(
+        (r, tk.w_sub(V, X3)), (Y1, J), (r, xq), (Z3, yq)
+    )
+    Y3 = tk.w_sub(Y3a, tk.w_double(Y3b))
+    lA = tk.w_sub(lA1, lA2)
+    lB = tk.w_neg(r)
+    lC = Z3
+    return (tk.w_out(X3), tk.w_out(Y3), tk.w_out(Z3)), (lA, lB, lC)
+
+
+def _mul_line_sparse_lazy(f, line_w, xp, yp):
+    """_mul_line_sparse with a WIDE line and lazy recombination; the
+    fp12 result normalizes once, over the full coefficient stack."""
+    A, B, C = tk.w_slim_many(*line_w)
+    bc = tk.w_mont_mul(
+        tk._w_stack([B, C], 0),
+        tk.w_strict(jnp.stack([xp, yp])[..., None, :, :]),
+    )
+    bxp, cyp = tk.w_slim_many(
+        tk._w_part(bc, 0, 0), tk._w_part(bc, 1, 0)
+    )
+
+    f0, f1 = f[..., 0, :, :, :, :], f[..., 1, :, :, :, :]
+    f0w, f1w = tk.w_strict(f0), tk.w_strict(f1)
+    f00, f01c, f02 = (tk._w_part(f0w, i, -4) for i in range(3))
+    g0, g1, g2 = (tk._w_part(f1w, i, -4) for i in range(3))
+    fs = tk.w_add(f0w, f1w)
+    s0, s1, s2 = (tk._w_part(fs, i, -4) for i in range(3))
+    Bc = tk.w_add(bxp, cyp)
+
+    (m0, m1, mx, mu, mv,
+     w2, w0, w1,
+     n0, n1, nx, nu, nv) = _muln2_w(
+        (f00, A), (f01c, bxp),
+        (tk.w_add(f00, f01c), tk.w_add(A, bxp)),
+        (f02, bxp), (f02, A),
+        (g2, cyp), (g0, cyp), (g1, cyp),
+        (s0, A), (s1, Bc),
+        (tk.w_add(s0, s1), tk.w_add(A, Bc)),
+        (s2, Bc), (s2, A),
+    )
+    t0 = tk._w_stack([
+        tk.w_add(m0, tk.w2_mul_by_xi(mu)),
+        tk.w_sub(tk.w_sub(mx, m0), m1),
+        tk.w_add(m1, mv),
+    ], -4)
+    t1 = tk._w_stack([tk.w2_mul_by_xi(w2), w0, w1], -4)
+    ts = tk._w_stack([
+        tk.w_add(n0, tk.w2_mul_by_xi(nu)),
+        tk.w_sub(tk.w_sub(nx, n0), n1),
+        tk.w_add(n1, nv),
+    ], -4)
+    c0 = tk.w_add(t0, tk.w6_mul_by_v(t1))
+    c1 = tk.w_sub(tk.w_sub(ts, t0), t1)
+    return tk.w_norm(tk._w_stack([c0, c1], -5))
+
+
 def _dbl_step(T):
     """Double T + line through T scaled by 2YZ^3 (pairing.py _dbl_step).
 
@@ -221,15 +343,23 @@ def miller_loop_t(p_aff, p_inf, q_aff, q_inf, bit_src=None):
     T0 = pt_from_affine(F2, q_aff[0], q_aff[1], q_inf)
     f0 = fp12_one_t(xp)
 
+    lazy = tk._lazy_enabled()  # trace-time; default OFF keeps the jaxpr
+
     def dbl_only(carry):
         f, T = carry
         f = fp12_sqr_t(f)
+        if lazy:
+            T2, line_w = _dbl_step_lazy(T)
+            return (_mul_line_sparse_lazy(f, line_w, xp, yp), T2)
         T2, line = _dbl_step(T)
         f = _mul_line_sparse(f, line, xp, yp)
         return (f, T2)
 
     def dbl_add(carry):
         f, T = dbl_only(carry)
+        if lazy:
+            Ta, line_w = _add_step_lazy(T, q_aff)
+            return (_mul_line_sparse_lazy(f, line_w, xp, yp), Ta)
         Ta, line_a = _add_step(T, q_aff)
         return (_mul_line_sparse(f, line_a, xp, yp), Ta)
 

@@ -12,7 +12,9 @@ math follows the EIP texts directly:
   (``derive_validator_keys``).
 * ``Keystore``          — EIP-2335 JSON: encrypt/decrypt a 32-byte
   secret under scrypt (stdlib hashlib) or pbkdf2, AES-128-CTR
-  (the `cryptography` package, present in this image).
+  (the `cryptography` package when available, else a pure-Python
+  AES fallback — keystore payloads are 32 bytes, so throughput is
+  irrelevant and the dependency stays optional).
 """
 
 from __future__ import annotations
@@ -105,8 +107,85 @@ def derive_validator_keys(seed: bytes, index: int) -> tuple[SecretKey, SecretKey
 # ------------------------------------------------------------------ EIP-2335
 
 
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+def _mk_sbox() -> list[int]:
+    # GF(2^8) inverse via exp/log over generator 3, then the FIPS-197
+    # affine transform; computed once instead of hardcoding 256 bytes.
+    def rotl(b: int, n: int) -> int:
+        return ((b << n) | (b >> (8 - n))) & 0xFF
+
+    exp, log = [0] * 255, [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i], log[x] = x, i
+        x ^= _xtime(x)  # multiply by the generator 0x03
+    sbox = []
+    for a in range(256):
+        inv = 0 if a == 0 else exp[(255 - log[a]) % 255]
+        sbox.append(inv ^ rotl(inv, 1) ^ rotl(inv, 2)
+                    ^ rotl(inv, 3) ^ rotl(inv, 4) ^ 0x63)
+    return sbox
+
+
+_SBOX = _mk_sbox()
+
+
+def _aes128_round_keys(key: bytes) -> list[list[int]]:
+    w = [list(key[i:i + 4]) for i in range(0, 16, 4)]
+    rcon = 0x01
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = [_SBOX[b] for b in t[1:] + t[:1]]
+            t[0] ^= rcon
+            rcon = _xtime(rcon)
+        w.append([a ^ b for a, b in zip(w[i - 4], t)])
+    # state and round keys share the flat column-major index r + 4c
+    return [sum(w[4 * r:4 * r + 4], []) for r in range(11)]
+
+
+def _aes128_encrypt_block(rks: list[list[int]], block: bytes) -> bytes:
+    def shift_rows(s: list[int]) -> list[int]:
+        return [s[r + 4 * ((c + r) % 4)] for c in range(4) for r in range(4)]
+
+    s = [b ^ k for b, k in zip(block, rks[0])]
+    for rnd in range(1, 10):
+        s = shift_rows([_SBOX[b] for b in s])
+        t = []
+        for c in range(4):
+            a = s[4 * c:4 * c + 4]
+            t += [
+                _xtime(a[0]) ^ _xtime(a[1]) ^ a[1] ^ a[2] ^ a[3],
+                a[0] ^ _xtime(a[1]) ^ _xtime(a[2]) ^ a[2] ^ a[3],
+                a[0] ^ a[1] ^ _xtime(a[2]) ^ _xtime(a[3]) ^ a[3],
+                _xtime(a[0]) ^ a[0] ^ a[1] ^ a[2] ^ _xtime(a[3]),
+            ]
+        s = [b ^ k for b, k in zip(t, rks[rnd])]
+    s = shift_rows([_SBOX[b] for b in s])
+    return bytes(b ^ k for b, k in zip(s, rks[10]))
+
+
+def _aes_128_ctr_py(key: bytes, iv: bytes, data: bytes) -> bytes:
+    rks = _aes128_round_keys(key)
+    ctr = int.from_bytes(iv, "big")
+    out = bytearray()
+    for off in range(0, len(data), 16):
+        block = ((ctr + off // 16) % (1 << 128)).to_bytes(16, "big")
+        ks = _aes128_encrypt_block(rks, block)
+        out += bytes(x ^ y for x, y in zip(data[off:off + 16], ks))
+    return bytes(out)
+
+
 def _aes_128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+    except ModuleNotFoundError:
+        return _aes_128_ctr_py(key, iv, data)
 
     cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
     enc = cipher.encryptor()

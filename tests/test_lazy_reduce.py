@@ -1,0 +1,328 @@
+"""Golden parity suite: lazy-reduction tower + MXU carry fold (ISSUE 18).
+
+Both knobs (LHTPU_LAZY_REDUCE, LHTPU_MXU_CARRY) are default-OFF; every
+test here flips them explicitly around a traced call and restores the
+environment, so the rest of the suite keeps the cached default-path
+graphs bit-identical.
+
+Parity levels, by design (see the tkernel lazy-section comment):
+* limb/Pallas MXU carry vs strict: BIT-identical — same [0, 2p)
+  representative, same digits;
+* lazy tower vs strict: canonical (mod-p) identical — the Montgomery
+  quotient of a wide product differs by multiples of R, so raw [0, 2p)
+  representatives may differ while every verdict and canonical form
+  must not.
+
+Everything traced is jitted at tiny shapes (T=2 lanes) so the work
+rides the persistent compile cache; eager tower chains at these sizes
+cost minutes on a 1-core host and are deliberately avoided.
+"""
+
+import os
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.ops import limb
+from lighthouse_tpu.ops import tkernel as tk
+from lighthouse_tpu.ops import tkernel_pairing as tp
+
+P = limb.P
+
+#: adversarial operand pool: the near-2p / near-p edges that break
+#: naive bound accounting, padded with randoms
+_EDGES = [0, 1, P - 1, P, P + 1, 2 * P - 1, 2 * P - 2]
+
+
+def _vals(rng, n):
+    pool = _EDGES + [rng.randrange(2 * P) for _ in range(n)]
+    return pool[:n] if n <= len(_EDGES) else (
+        _EDGES + [rng.randrange(2 * P) for _ in range(n - len(_EDGES))]
+    )
+
+
+def _limbs_t(vals):
+    return tk.batch_to_t(limb.ints_to_limbs(vals))
+
+
+def _to_ints(batch):
+    arr = np.asarray(batch)
+    return [limb.limbs_to_int(arr[i]) for i in range(arr.shape[0])]
+
+
+class _knobs:
+    """Context manager: set LHTPU_* knobs, restore on exit."""
+
+    NAMES = ("LHTPU_LAZY_REDUCE", "LHTPU_MXU_CARRY", "LHTPU_KS_CHECK")
+
+    def __init__(self, **env):
+        self.env = env
+
+    def __enter__(self):
+        self.saved = {k: os.environ.get(k) for k in self.NAMES}
+        for k in self.NAMES:
+            os.environ.pop(k, None)
+        os.environ.update(self.env)
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestWideAlgebra:
+    """Host-level checks of the _Wide ledger algebra itself."""
+
+    def test_add_sub_chain_value_exact(self):
+        rng = random.Random(31)
+        va, vb, vc = (_vals(rng, 7) for _ in range(3))
+        a, b, c = (tk.w_strict(_limbs_t(v)) for v in (va, vb, vc))
+        # a long carry-free chain, signed digits, then ONE norm
+        w = tk.w_sub(tk.w_add(tk.w_double(a), b), tk.w_double(tk.w_add(b, c)))
+        got = _to_ints(tk.batch_from_t(tk.w_norm(w)))
+        for ga, (x, y, z) in zip(got, zip(va, vb, vc)):
+            assert ga == (2 * x + y - 2 * (y + z)) % (2 * P)
+
+    def test_norm_bounds_and_digits(self):
+        rng = random.Random(32)
+        v = _vals(rng, 7)
+        w = tk.w_strict(_limbs_t(v))
+        for _ in range(4):  # value up to 16 * (2p - 1)
+            w = tk.w_add(w, w)
+        out = np.asarray(tk.w_norm(w))
+        assert out.min() >= 0 and out.max() <= 255
+        got = _to_ints(tk.batch_from_t(jnp.asarray(out)))
+        for ga, x in zip(got, v):
+            assert ga == (16 * x) % (2 * P)
+
+    def test_slim_is_identity_mod_p(self):
+        rng = random.Random(33)
+        v = _vals(rng, 7)
+        w = tk.w_sub(tk.w_strict(_limbs_t(v)),
+                     tk.w_double(tk.w_strict(_limbs_t(list(reversed(v))))))
+        s = tk._w_slim(w, cap=0)  # force the squeeze
+        assert s.vmin >= 0 and s.vmax < 2 * P and s.dmax <= 255
+        a = _to_ints(tk.batch_from_t(tk.w_norm(w)))
+        b = _to_ints(tk.batch_from_t(tk.w_norm(s)))
+        assert [x % P for x in a] == [y % P for y in b]
+
+    def test_w_out_contract(self):
+        """w_out must emit PROVEN-strict digits: the Z3 = 2*Zh shape
+        (vmax 4p, dmax 510) that w_slim_many leaves untouched."""
+        rng = random.Random(34)
+        w = tk.w_double(tk.w_strict(_limbs_t(_vals(rng, 7))))
+        assert w.vmax >= 2 * P  # the hazard: not strict, slim won't fire
+        out = np.asarray(tk.w_out(w))
+        assert out.min() >= 0 and out.max() <= 255
+        vals = _to_ints(tk.batch_from_t(jnp.asarray(out)))
+        assert all(x < 2 * P for x in vals)
+
+
+class TestLazyTowerParity:
+    """fp2/fp6/fp12 products: lazy vs strict at canonical level."""
+
+    def _pair(self, rng, shape_limbs):
+        n = int(np.prod(shape_limbs))
+        a = limb.ints_to_limbs(_vals(rng, 2 * n)[:n]).reshape(*shape_limbs, 48)
+        b = limb.ints_to_limbs(_vals(rng, 2 * n)[n:]).reshape(*shape_limbs, 48)
+        return tk.batch_to_t(a), tk.batch_to_t(b)
+
+    def _parity(self, fn, at, bt, env):
+        ref = np.asarray(jax.jit(fn)(at, bt))
+        with _knobs(**env):
+            got = np.asarray(jax.jit(fn)(at, bt))
+        assert np.array_equal(ref, got)
+
+    def test_fp2_mul_each_knob(self):
+        rng = random.Random(41)
+        at, bt = self._pair(rng, (4, 2))
+        fn = lambda x, y: tk.canonical_t(tk.fp2_mul_t(x, y))
+        for env in ({"LHTPU_LAZY_REDUCE": "1"},
+                    {"LHTPU_MXU_CARRY": "1"},
+                    {"LHTPU_LAZY_REDUCE": "1", "LHTPU_MXU_CARRY": "1",
+                     "LHTPU_KS_CHECK": "1"}):
+            self._parity(fn, at, bt, env)
+
+    def test_fp2_sqr(self):
+        rng = random.Random(42)
+        at, _ = self._pair(rng, (4, 2))
+        ref = np.asarray(jax.jit(lambda x: tk.canonical_t(tk.fp2_sqr_t(x)))(at))
+        with _knobs(LHTPU_LAZY_REDUCE="1", LHTPU_MXU_CARRY="1"):
+            got = np.asarray(
+                jax.jit(lambda x: tk.canonical_t(tk.fp2_sqr_t(x)))(at))
+        assert np.array_equal(ref, got)
+
+    def test_fp6_mul(self):
+        rng = random.Random(43)
+        at, bt = self._pair(rng, (1, 3, 2))
+        fn = lambda x, y: tk.canonical_t(tk.fp6_mul_t(x, y))
+        self._parity(fn, at, bt,
+                     {"LHTPU_LAZY_REDUCE": "1", "LHTPU_MXU_CARRY": "1"})
+
+    def test_fp12_mul_sqr(self):
+        rng = random.Random(44)
+        at, bt = self._pair(rng, (1, 2, 3, 2))
+        fn = lambda x, y: tk.canonical_t(
+            tk.fp12_sqr_t(tk.fp12_mul_t(x, y)))
+        self._parity(fn, at, bt,
+                     {"LHTPU_LAZY_REDUCE": "1", "LHTPU_MXU_CARRY": "1"})
+
+
+class TestLineEvalParity:
+    """One Miller doubling body + one mixed-add body, chained so the
+    loop-carried point crosses the lazy/strict domain boundary (the
+    w_out contract), lazy vs strict at canonical level."""
+
+    def test_body_chain(self):
+        rng = random.Random(51)
+
+        def fp2():
+            return jnp.stack([_limbs_t(_vals(rng, 2)),
+                              _limbs_t(_vals(rng, 2))])
+
+        f = jnp.stack([jnp.stack([fp2() for _ in range(3)]),
+                       jnp.stack([fp2() for _ in range(3)])])
+        Xc, Yc, Zc, xq, yq = (fp2() for _ in range(5))
+        xp, yp = _limbs_t(_vals(rng, 2)), _limbs_t(_vals(rng, 2))
+
+        def chain(f, Xc, Yc, Zc, xq, yq, xp, yp):
+            T0 = (Xc, Yc, Zc)
+            if tk._lazy_enabled():
+                T0, lw = tp._dbl_step_lazy(T0)
+                f = tp._mul_line_sparse_lazy(f, lw, xp, yp)
+                T0, lw = tp._add_step_lazy(T0, (xq, yq))
+                f = tp._mul_line_sparse_lazy(f, lw, xp, yp)
+            else:
+                T0, line = tp._dbl_step(T0)
+                f = tp._mul_line_sparse(f, line, xp, yp)
+                T0, line = tp._add_step(T0, (xq, yq))
+                f = tp._mul_line_sparse(f, line, xp, yp)
+            return tk.canonical_t(f), tuple(tk.canonical_t(c) for c in T0)
+
+        args = (f, Xc, Yc, Zc, xq, yq, xp, yp)
+        ref_f, ref_T = jax.jit(chain)(*args)
+        with _knobs(LHTPU_LAZY_REDUCE="1", LHTPU_MXU_CARRY="1",
+                    LHTPU_KS_CHECK="1"):
+            got_f, got_T = jax.jit(chain)(*args)
+        assert np.array_equal(np.asarray(ref_f), np.asarray(got_f))
+        for rc, gc in zip(ref_T, got_T):
+            assert np.array_equal(np.asarray(rc), np.asarray(gc))
+
+
+@pytest.mark.slow  # TRACING-bound, not compile-bound: the lazy Miller
+# trace alone costs ~270 s on the 1-core host even with every compile
+# riding the persistent cache. Verdict-level lazy parity stays covered
+# in tier-1 time budgets by the fault-drill `lazy-tower` cell
+# (tools/fault_drill.py run_drill_lazy), which asserts the same
+# bit-identical triage verdicts strict-vs-lazy.
+class TestPairingVerdict:
+    """Pairing-level gate: triaged verify verdicts must be BIT-identical
+    lazy vs strict — the knob changes limb representatives mid-chain,
+    never verdicts. Pinned to the same (S=4, G=2) + (S=2, G=2) compile
+    buckets that tests/test_triage.py and the fault-drill lazy cell pay
+    for, so every compile rides the persistent cache; knobs are read at
+    trace time, so the in-process jit caches drop around each flip."""
+
+    def _sets(self):
+        from lighthouse_tpu.crypto.bls.api import (
+            AggregateSignature, SecretKey, SignatureSet)
+
+        sks = [SecretKey.from_int(i + 7) for i in range(6)]
+        bad = b"\xee" * 32
+        sets = []
+        for i in range(4):
+            m = bytes([i + 1]) * 32
+            signed = bad if i == 2 else m
+            if i % 2 == 0:
+                sets.append(SignatureSet.single_pubkey(
+                    sks[i].sign(signed), sks[i].public_key(), m))
+            else:
+                a, b = sks[i], sks[i + 2]
+                agg = AggregateSignature.aggregate(
+                    [a.sign(signed), b.sign(m)])
+                sets.append(SignatureSet.multiple_pubkeys(
+                    agg, [a.public_key(), b.public_key()], m))
+        return sets
+
+    def test_triaged_verdicts_bit_identical(self):
+        from lighthouse_tpu import jax_backend as jb
+
+        sets = self._sets()
+        saved = {k: os.environ.get(k)
+                 for k in ("LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS")}
+        os.environ["LHTPU_PIPELINE"] = "0"
+        os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+        try:
+            be = jb.JaxBackend()
+            with _knobs():  # all lazy knobs explicitly OFF
+                jax.clear_caches()
+                strict = be.verify_signature_sets_triaged(sets)
+            with _knobs(LHTPU_LAZY_REDUCE="1"):
+                jax.clear_caches()
+                lazy = be.verify_signature_sets_triaged(sets)
+            assert strict == lazy == [True, True, False, True]
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            jax.clear_caches()
+
+
+class TestMxuCarryBitExact:
+    """The MXU carry fold is a drop-in for the strict walk: BIT-exact."""
+
+    def test_limb_ops(self):
+        rng = random.Random(61)
+        va = _vals(rng, 12)
+        vb = list(reversed(va))
+        a = jnp.asarray(limb.ints_to_limbs(va))
+        b = jnp.asarray(limb.ints_to_limbs(vb))
+        ref = [np.asarray(f(a, b)) for f in (limb.add, limb.sub,
+                                             limb.mont_mul)]
+        ref.append(np.asarray(limb.canonical(a)))
+        with _knobs(LHTPU_MXU_CARRY="1"):
+            got = [np.asarray(f(a, b)) for f in (limb.add, limb.sub,
+                                                 limb.mont_mul)]
+            got.append(np.asarray(limb.canonical(a)))
+        for r, g in zip(ref, got):
+            assert np.array_equal(r, g)
+
+    def test_tkernel_ops(self):
+        rng = random.Random(62)
+        at = _limbs_t(_vals(rng, 8))
+        bt = _limbs_t(list(reversed(_vals(rng, 8))))
+
+        def ops(x, y):
+            return (tk.add_t(x, y), tk.sub_t(x, y),
+                    tk.mont_mul_t(x, y), tk.canonical_t(x))
+
+        ref = jax.jit(ops)(at, bt)
+        with _knobs(LHTPU_MXU_CARRY="1", LHTPU_KS_CHECK="1"):
+            got = jax.jit(ops)(at, bt)
+        for r, g in zip(ref, got):
+            assert np.array_equal(np.asarray(r), np.asarray(g))
+
+    def test_pallas_interpret(self):
+        rng = random.Random(63)
+        from lighthouse_tpu.ops.pallas_mont import mont_mul_pallas
+
+        va = _vals(rng, 9)
+        vb = list(reversed(va))
+        a = jnp.asarray(limb.ints_to_limbs(va))
+        b = jnp.asarray(limb.ints_to_limbs(vb))
+        ref = np.asarray(mont_mul_pallas(a, b))
+        with _knobs(LHTPU_MXU_CARRY="1"):
+            got = np.asarray(mont_mul_pallas(a, b))
+        assert np.array_equal(ref, got)
+        # and the oracle agrees
+        r_inv = pow(1 << 384, -1, P)
+        for i, (x, y) in enumerate(zip(va, vb)):
+            v = limb.limbs_to_int(got[i])
+            assert 0 <= v < 2 * P and (v - x * y * r_inv) % P == 0

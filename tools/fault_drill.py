@@ -506,6 +506,88 @@ def run_drill_multichip(kinds=MULTICHIP_KINDS, backend=None):
     return results
 
 
+def run_drill_lazy(backend=None):
+    """ISSUE 18 cell: a mosaic fault while the LAZY-REDUCTION pairing
+    tower is live must degrade down the ladder with per-set verdicts
+    bit-identical to the strict baseline — the knob changes limb
+    representatives mid-chain, never verdicts, and a faulted lazy
+    dispatch must land on a rung that agrees with strict bit-for-bit.
+
+    The knobs are read at TRACE time, so the in-process jit caches are
+    dropped around the flip (the persistent .jax_cache absorbs the
+    recompiles after the first run)."""
+    import jax
+
+    from lighthouse_tpu import jax_backend as jb
+    from lighthouse_tpu.common import resilience
+
+    if backend is None:
+        backend = jb.JaxBackend()
+    sets, expected = _mk_poisoned_sets()
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LHTPU_FAULT_INJECT", "LHTPU_RETRY_BASE_MS",
+                  "LHTPU_PIPELINE", "LHTPU_VERDICT_GROUPS",
+                  "LHTPU_LAZY_REDUCE", "LHTPU_MXU_CARRY")
+    }
+    os.environ["LHTPU_RETRY_BASE_MS"] = "0"
+    os.environ["LHTPU_PIPELINE"] = "0"
+    os.environ["LHTPU_VERDICT_GROUPS"] = "2"
+    os.environ.pop("LHTPU_FAULT_INJECT", None)
+    os.environ.pop("LHTPU_LAZY_REDUCE", None)
+    os.environ.pop("LHTPU_MXU_CARRY", None)
+    results = []
+    try:
+        baseline = backend.verify_signature_sets_triaged(sets)
+        assert baseline == expected, f"strict baseline broken: {baseline}"
+
+        os.environ["LHTPU_LAZY_REDUCE"] = "1"
+        jax.clear_caches()
+        healthy = backend.verify_signature_sets_triaged(sets)
+        lazy_parity = healthy == baseline
+
+        resilience.reset()
+        retries0 = _total(resilience.RETRIES_TOTAL)
+        degraded0 = _total(resilience.DEGRADED_TOTAL)
+        os.environ["LHTPU_FAULT_INJECT"] = "dispatch:mosaic:1"
+        error = None
+        try:
+            verdict = backend.verify_signature_sets_triaged(sets)
+        except Exception as exc:  # contract breach, not a crash
+            verdict = None
+            cat, kind_c = resilience.classify(exc)
+            error = f"{type(exc).__name__}: {exc} [{cat}/{kind_c}]"
+        finally:
+            os.environ.pop("LHTPU_FAULT_INJECT", None)
+        retries = _total(resilience.RETRIES_TOTAL) - retries0
+        degraded = _total(resilience.DEGRADED_TOTAL) - degraded0
+        if not lazy_parity:
+            error = (error or "") + f" lazy healthy pass != strict: {healthy}"
+        results.append({
+            "mode": "lazy-tower",
+            "stage": "dispatch",
+            "kind": "mosaic",
+            "category": "permanent",
+            "verdict": verdict == baseline if verdict is not None else None,
+            "retries": retries,
+            "degraded": degraded,
+            "path": backend.last_path,
+            "healthy_path": None,
+            "error": error or None,
+            "ok": lazy_parity and verdict == baseline and degraded >= 1,
+        })
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        resilience.reset()
+        jax.clear_caches()  # drop the lazy-traced programs
+    return results
+
+
 def run_drill_soak():
     """Multi-epoch soak drill (ISSUE 7): two endurance cells over
     ``loadgen/soak.SoakRunner`` on the virtual clock, aggregate-only
@@ -964,7 +1046,7 @@ def main() -> int:
     triage_stages = QUICK_STAGES if "--quick" in sys.argv else TRIAGE_STAGES
     n_multichip = len(MULTICHIP_KINDS) if len(jax.devices()) > 1 else 0
     print(f"device={jax.devices()[0].platform} "
-          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip + 4 + 3}",
+          f"cells={(len(stages) + len(QUICK_STAGES) + len(triage_stages) + 1) * len(KINDS) + 2 + n_multichip + 4 + 3 + 1}",
           file=out)
     results = run_drill(stages=stages)
     # Pipelined matrix (3-stage subset): per-chunk retry and
@@ -993,6 +1075,11 @@ def main() -> int:
     # blocks never shed, and a slasher-stage fault falling back to the
     # host scan with bit-identical findings.
     results += run_drill_weather()
+    # Lazy-tower cell (ISSUE 18): a mosaic fault with LHTPU_LAZY_REDUCE
+    # live must degrade to a rung bit-identical to the strict baseline.
+    # Runs LAST: it clears the in-process jit caches around the knob
+    # flip, which would force earlier drills to re-trace.
+    results += run_drill_lazy()
     failed = [r for r in results if not r["ok"]]
 
     header = (f"{'mode':12s} {'stage':14s} {'kind':16s} {'class':10s} "

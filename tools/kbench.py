@@ -4,7 +4,7 @@ Usage: python tools/kbench.py [--fresh] [S] [name ...]
 
 Names: scalar_g1 scalar_g2 subgroup subgroup_full to_affine_g1
        to_affine_g2 miller sswu sswu_iso cofactor psi_subgroup
-       map_resident final_exp
+       map_resident mont_mul_dense fp2_mul line_eval final_exp
 
 Each kernel is compiled (persistent cache), warmed, then timed over
 REPS=5 with block_until_ready. Inputs are generator-point lanes — timing
@@ -58,8 +58,14 @@ def timeit(label, fn):
 
 
 #: default rows for --fresh: the hash-side kernels whose ISSUE 10 wins
-#: are claimed per-kernel (cold process each, no shared device state).
-FRESH_NAMES = ("sswu_iso", "cofactor", "psi_subgroup")
+#: are claimed per-kernel (cold process each, no shared device state) —
+#: including map_resident, whose PR-10 claim previously had no cold row —
+#: plus the carry-chain trio (mont_mul_dense, fp2_mul, line_eval) that
+#: measures the LHTPU_LAZY_REDUCE / LHTPU_MXU_CARRY bar per-kernel.
+FRESH_NAMES = (
+    "sswu_iso", "cofactor", "psi_subgroup", "map_resident",
+    "mont_mul_dense", "fp2_mul", "line_eval",
+)
 
 
 def run_fresh(S: int, names) -> int:
@@ -157,6 +163,38 @@ def main():
             )
             timeit("map_resident (sswu..cof)", lambda:
                    _map_to_g2_resident_t(us, _interpret()))
+        elif name == "mont_mul_dense":
+            # dependent chain so the carry path is on the critical path,
+            # not hidden behind the conv's MXU throughput
+            @jax.jit
+            def _mm16(x, y):
+                for _ in range(16):
+                    x = tk.mont_mul_t(x, y)
+                return x
+            timeit("mont_mul_dense (x16)", lambda: _mm16(g1x, g1y))
+        elif name == "fp2_mul":
+            @jax.jit
+            def _fp2x8(x, y):
+                for _ in range(8):
+                    x = tk.fp2_mul_t(x, y)
+                return x
+            timeit("fp2_mul (x8)", lambda: _fp2x8(g2x, g2y))
+        elif name == "line_eval":
+            # one Miller-loop body iteration: doubling step + sparse
+            # f*line product, lazy/strict chosen by knob at trace time
+            from lighthouse_tpu.ops import tkernel_pairing as tp
+
+            @jax.jit
+            def _line(f, X, Y, Z, xp, yp):
+                if tk._lazy_enabled():
+                    T2, line_w = tp._dbl_step_lazy((X, Y, Z))
+                    return tp._mul_line_sparse_lazy(f, line_w, xp, yp)
+                T2, line = tp._dbl_step((X, Y, Z))
+                return tp._mul_line_sparse(f, line, xp, yp)
+
+            f12 = tp.fp12_one_t(g1x)
+            timeit("line_eval (dbl+sparse)", lambda: _line(
+                f12, jac2[0], jac2[1], jac2[2], g1x, g1y))
         elif name == "final_exp":
             f = jnp.broadcast_to(
                 jnp.zeros((2, 3, 2, 48, 1), jnp.int32).at[0, 0, 0].set(tk._c("R")),

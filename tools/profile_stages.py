@@ -269,6 +269,16 @@ def main():
             record(f"{stage} (dup={dup})", sub.get(stage, 0.0) * 1e3)
         record(f"hash_message_bytes e2e (dup={dup})", total)
 
+    # ------------------------------------------- counted-op model rows
+    # Per-set instance counts of the pairing hot bodies (ISSUE 18): the
+    # stage-level evidence that a knob moved the CARRY/MAC mix, not just
+    # the headline ms. Abstract traces only — no compiles.
+    op_model = counted_op_model()
+    for cfg, counts in op_model["configs"].items():
+        print(f"op_model[{cfg}]  " + "  ".join(
+            f"{k}={v}" for k, v in sorted(counts.items())),
+            file=sys.stderr if JSON_MODE else sys.stdout)
+
     # ------------------------------------------- pipelined overlap report
     # One end-to-end verify through the pipelined microbatch engine
     # (common/pipeline.py): per host stage, how many seconds ran hidden
@@ -283,8 +293,104 @@ def main():
             "detail": {"S": S, "K": K,
                        "device": jax.devices()[0].platform,
                        "verdict_groups": G,
-                       "overlap": overlap},
+                       "overlap": overlap,
+                       "stages": {"op_model": op_model}},
         }), flush=True)
+
+
+def counted_op_model() -> dict:
+    """Per-set counted-op model of the PAIRING hot path (ISSUE 18).
+
+    Counts op INSTANCES (a stacked call-site = 1, matching the README
+    roofline methodology) by abstractly tracing the Miller-loop bodies
+    — doubling step + sparse f*line product, and the mixed-add body —
+    with tkernel's trace-time counters under each knob configuration,
+    then extrapolates with the static schedule (63 dbl + 5 dbl_add
+    bodies for the BLS12-381 |x|). jax.eval_shape only: no XLA compile,
+    so this costs trace time (~seconds), not compile minutes.
+
+    Emitted metrics per config: schoolbook (VPU) MACs, serial / KS /
+    MXU carry-chain instances, lazy w_norm passes, MXU MACs. Stages the
+    knobs do not touch (ladders, sswu, host) are unchanged by
+    construction and omitted — compare configs row-to-row."""
+    from lighthouse_tpu.crypto.bls.constants import X as _BLS_X
+    from lighthouse_tpu.ops import tkernel_pairing as tp
+
+    n_dbl = abs(_BLS_X).bit_length() - 1
+    n_add = bin(abs(_BLS_X)).count("1") - 1
+
+    fp = jax.ShapeDtypeStruct((48, 1), jnp.int32)
+    fp2 = jax.ShapeDtypeStruct((2, 48, 1), jnp.int32)
+    f12 = jax.ShapeDtypeStruct((2, 3, 2, 48, 1), jnp.int32)
+
+    # bodies are (re)defined per config: jax.eval_shape caches traces on
+    # (function identity, avals), and a cache hit skips the Python trace
+    # the counters live in — a stale closure would count zero
+    def make_bodies():
+        def dbl_body(f, X, Y, Z, xp, yp):
+            if tk._lazy_enabled():
+                T2, line_w = tp._dbl_step_lazy((X, Y, Z))
+                return tp._mul_line_sparse_lazy(f, line_w, xp, yp), T2
+            T2, line = tp._dbl_step((X, Y, Z))
+            return tp._mul_line_sparse(f, line, xp, yp), T2
+
+        def add_body(f, X, Y, Z, xq, yq, xp, yp):
+            if tk._lazy_enabled():
+                Ta, line_w = tp._add_step_lazy((X, Y, Z), (xq, yq))
+                return tp._mul_line_sparse_lazy(f, line_w, xp, yp), Ta
+            Ta, line = tp._add_step((X, Y, Z), (xq, yq))
+            return tp._mul_line_sparse(f, line, xp, yp), Ta
+
+        return dbl_body, add_body
+
+    def trace_counts(fn, *argspecs):
+        with tk.count_ops() as counts:
+            jax.eval_shape(fn, *argspecs)
+        return counts
+
+    configs = {
+        "strict": {},
+        "lazy": {"LHTPU_LAZY_REDUCE": "1"},
+        "mxu_carry": {"LHTPU_MXU_CARRY": "1"},
+        "lazy+mxu_carry": {"LHTPU_LAZY_REDUCE": "1",
+                           "LHTPU_MXU_CARRY": "1"},
+    }
+    knob_names = ("LHTPU_LAZY_REDUCE", "LHTPU_MXU_CARRY")
+    saved = {k: os.environ.get(k) for k in knob_names}
+    out: dict[str, dict[str, int]] = {}
+    try:
+        for name, env in configs.items():
+            for k in knob_names:
+                os.environ.pop(k, None)
+            os.environ.update(env)
+            dbl_body, add_body = make_bodies()
+            dbl = trace_counts(dbl_body, f12, fp2, fp2, fp2, fp, fp)
+            add = trace_counts(
+                add_body, f12, fp2, fp2, fp2, fp2, fp2, fp, fp)
+            total = {
+                k: n_dbl * dbl.get(k, 0) + n_add * add.get(k, 0)
+                for k in set(dbl) | set(add)
+            }
+            out[name] = {
+                "schoolbook_macs": total.get("conv_mac", 0)
+                + total.get("fold_vpu_mac", 0),
+                "carry_serial": total.get("carry_serial", 0),
+                "carry_ks": total.get("carry_ks", 0),
+                "carry_mxu": total.get("carry_mxu", 0),
+                "w_norm_passes": total.get("w_norm", 0),
+                "mont_products": total.get("mont_product", 0),
+                "mxu_macs": total.get("mxu_mac", 0),
+            }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "unit": "per pairing lane (63 dbl + 5 dbl_add bodies)",
+        "configs": out,
+    }
 
 
 def profile_multichip(n_dev: int) -> None:
